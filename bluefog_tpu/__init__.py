@@ -1,0 +1,19 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""bluefog_tpu: a TPU-native decentralized (gossip) training framework.
+
+Capability parity with BlueFog (reference at /root/reference) re-designed for
+JAX/XLA SPMD over TPU meshes: neighbor collectives are ``ppermute`` schedules
+over ICI, window-style asynchronous algorithms are buffered step-synchronous
+neighbor state, and the optimizer wrappers drive pjit-compiled train steps.
+
+The user-facing facade mirrors ``bluefog.torch``::
+
+    import bluefog_tpu as bf
+    bf.init()
+    x = bf.worker_values(lambda rank: ...)   # stacked [size, ...] array
+    y = bf.neighbor_allreduce(x)
+"""
+
+from bluefog_tpu.version import __version__
+from bluefog_tpu import topology
+from bluefog_tpu import topology as topology_util  # reference-style alias

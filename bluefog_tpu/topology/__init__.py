@@ -1,0 +1,64 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Topology toolkit: static graph generators, dynamic one-peer schedules,
+weight helpers, and TPU torus placement.
+
+Parity surface: reference ``bluefog/common/topology_util.py`` and
+``bluefog/torch/topology_util.py``.
+"""
+
+from bluefog_tpu.topology.graphs import (
+    ExponentialTwoGraph,
+    ExponentialGraph,
+    SymmetricExponentialGraph,
+    MeshGrid2DGraph,
+    StarGraph,
+    RingGraph,
+    FullyConnectedGraph,
+    IsTopologyEquivalent,
+    IsRegularGraph,
+    GetRecvWeights,
+    GetSendWeights,
+    isPowerOf,
+)
+from bluefog_tpu.topology.dynamic import (
+    GetDynamicOnePeerSendRecvRanks,
+    GetExp2DynamicSendRecvMachineRanks,
+    GetInnerOuterRingDynamicSendRecvRanks,
+    GetInnerOuterExpo2DynamicSendRecvRanks,
+)
+from bluefog_tpu.topology.infer import (
+    InferSourceFromDestinationRanks,
+    InferDestinationFromSourceRanks,
+)
+from bluefog_tpu.topology.placement import (
+    serpentine_device_order,
+    worker_device_order,
+)
+
+# Reference alias: PowerTwoRingGraph was the pre-0.3 name for
+# ExponentialTwoGraph (used in reference docstrings/examples).
+PowerTwoRingGraph = ExponentialTwoGraph
+
+__all__ = [
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "RingGraph",
+    "FullyConnectedGraph",
+    "PowerTwoRingGraph",
+    "IsTopologyEquivalent",
+    "IsRegularGraph",
+    "GetRecvWeights",
+    "GetSendWeights",
+    "isPowerOf",
+    "GetDynamicOnePeerSendRecvRanks",
+    "GetExp2DynamicSendRecvMachineRanks",
+    "GetInnerOuterRingDynamicSendRecvRanks",
+    "GetInnerOuterExpo2DynamicSendRecvRanks",
+    "InferSourceFromDestinationRanks",
+    "InferDestinationFromSourceRanks",
+    "serpentine_device_order",
+    "worker_device_order",
+]

@@ -1,0 +1,184 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Dynamic (per-iteration) one-peer topology schedules.
+
+API parity with the dynamic generators in the reference
+``bluefog/common/topology_util.py:315-554``: infinite iterators yielding
+``([send_ranks], [recv_ranks])`` per iteration.
+
+TPU-native note: these schedules are *periodic* — a rank's sequence of peers
+repeats with a small period (e.g. log2(N) for Exponential-2). The compiled
+path therefore never consumes these iterators inside a step; instead
+:mod:`bluefog_tpu.parallel.plan` extracts the full period once as a static
+permutation table and selects the round with ``lax.switch`` on the step index
+(no retrace, no host round-trip). The iterators remain the user-facing,
+reference-compatible way to drive the eager API and the optimizers'
+``dst_weights``/``src_weights`` knobs per iteration.
+"""
+
+from typing import Iterator, List, Tuple
+
+import numpy as np
+import networkx as nx
+
+__all__ = [
+    "GetDynamicOnePeerSendRecvRanks",
+    "GetExp2DynamicSendRecvMachineRanks",
+    "GetInnerOuterRingDynamicSendRecvRanks",
+    "GetInnerOuterExpo2DynamicSendRecvRanks",
+]
+
+
+def _sorted_out_neighbors(topo: nx.DiGraph, rank: int) -> List[int]:
+    """Out-neighbors of ``rank`` sorted by clockwise ring distance, self-loop
+    removed (reference topology_util.py:334-342)."""
+    size = topo.number_of_nodes()
+    ranks = sorted(
+        topo.successors(rank),
+        key=lambda r: (r - rank) % size if r != rank else 0,
+    )
+    return [r for r in ranks if r != rank]
+
+
+def GetDynamicOnePeerSendRecvRanks(
+    topo: nx.DiGraph, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Cycle through the base topology's out-neighbors one at a time.
+
+    At iteration t every rank r sends to its (t mod out_degree(r))-th
+    clockwise out-neighbor; the recv list is every rank whose pick lands on
+    ``self_rank``. Parity: reference topology_util.py:315-357.
+    """
+    size = topo.number_of_nodes()
+    send_lists = [_sorted_out_neighbors(topo, r) for r in range(size)]
+    index = 0
+    while True:
+        send_rank = send_lists[self_rank][index % len(send_lists[self_rank])]
+        recv_ranks = [
+            r
+            for r in range(size)
+            if r != self_rank
+            and send_lists[r][index % len(send_lists[r])] == self_rank
+        ]
+        yield [send_rank], recv_ranks
+        index += 1
+
+
+def GetExp2DynamicSendRecvMachineRanks(
+    world_size: int, local_size: int, self_rank: int, local_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """One-peer Exponential-2 schedule at *machine* granularity.
+
+    Used with hierarchical_neighbor_allreduce: machine m sends to machine
+    m + 2^(t mod K) and receives from m - 2^(t mod K).
+    Parity: reference topology_util.py:360-396.
+    """
+    assert (self_rank % local_size) == local_rank, \
+        "It should be used under homogeneous environment only."
+    assert (world_size % local_size) == 0, \
+        "It should be used under homogeneous environment only."
+    assert world_size > local_size, \
+        "It should be used under at least two machines case."
+
+    machine_id = self_rank // local_size
+    machine_size = world_size // local_size
+    exp_2_size = int(np.log2(machine_size - 1)) if machine_size > 1 else 0
+    index = 0
+    while True:
+        dist = 2 ** (index % (exp_2_size + 1))
+        yield [(machine_id + dist) % machine_size], [(machine_id - dist) % machine_size]
+        index += 1
+
+
+def GetInnerOuterRingDynamicSendRecvRanks(
+    world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Inner-ring / outer-ring one-peer schedule for multi-chip hosts.
+
+    Each iteration designates one local slot as the "outside" talker: that
+    rank exchanges with the same slot on the neighboring machines (outer
+    ring); everyone else walks a ring inside the machine, skipping the
+    outside slot. Parity: reference topology_util.py:399-463.
+    """
+    num_machines = world_size // local_size
+    nodes_per_machine = local_size
+    assert world_size % local_size == 0, \
+        "It should be used under homogeneous environment only."
+    assert local_size > 2, (
+        "Do no support the case where nodes_per_machine is equal or less "
+        "than 2. Consider use hierarchical_neighbor_allreduce or "
+        "GetDynamicOnePeerSendRecvRanks."
+    )
+
+    machine_id = self_rank // nodes_per_machine
+    local_rank_id = self_rank % nodes_per_machine
+    index = 0
+    while True:
+        outside_slot = index % nodes_per_machine
+        if outside_slot == local_rank_id:
+            send_rank = ((machine_id + 1) % num_machines) * nodes_per_machine + local_rank_id
+            recv_rank = ((machine_id - 1) % num_machines) * nodes_per_machine + local_rank_id
+        else:
+            target = (local_rank_id + 1) % nodes_per_machine
+            if target == outside_slot:
+                target = (target + 1) % nodes_per_machine
+            send_rank = machine_id * nodes_per_machine + target
+
+            source = (local_rank_id - 1) % nodes_per_machine
+            if source == outside_slot:
+                source = (source - 1) % nodes_per_machine
+            recv_rank = machine_id * nodes_per_machine + source
+        yield [send_rank], [recv_rank]
+        index += 1
+
+
+def GetInnerOuterExpo2DynamicSendRecvRanks(
+    world_size: int, local_size: int, self_rank: int
+) -> Iterator[Tuple[List[int], List[int]]]:
+    """Inner-Exp2 / outer-Exp2 one-peer schedule — the reference's flagship
+    multi-GPU-node topology (BASELINE north star).
+
+    Like the inner/outer ring but both rings hop by powers of two; the inner
+    hop is shifted past the outside slot so the inner exchange never collides
+    with the rank that is talking across machines this round.
+    Parity: reference topology_util.py:466-554.
+    """
+    num_machines = world_size // local_size
+    nodes_per_machine = local_size
+    assert world_size % local_size == 0, \
+        "It should be used under homogeneous environment only."
+    assert local_size > 2, (
+        "Do no support the case where nodes_per_machine is equal or less "
+        "than 2. Consider use hierarchical_neighbor_allreduce or "
+        "GetDynamicOnePeerSendRecvRanks."
+    )
+
+    exp_2_out_size = int(np.log2(num_machines - 1))
+    if nodes_per_machine == 2:
+        exp_2_in_size = 0
+    else:
+        # -2: the slot talking outside is excluded from the inner ring.
+        exp_2_in_size = int(np.log2(nodes_per_machine - 2))
+
+    machine_id = self_rank // nodes_per_machine
+    local_rank_id = self_rank % nodes_per_machine
+    index = 0
+    while True:
+        outside_slot = index % nodes_per_machine
+        if outside_slot == local_rank_id:
+            dist = 2 ** (index % (exp_2_out_size + 1))
+            send_rank = ((machine_id + dist) % num_machines) * nodes_per_machine + local_rank_id
+            recv_rank = ((machine_id - dist) % num_machines) * nodes_per_machine + local_rank_id
+        else:
+            base_dist = 2 ** (index % (exp_2_in_size + 1))
+
+            dist_to_out = (outside_slot - local_rank_id) % nodes_per_machine
+            send_dist = base_dist + 1 if base_dist >= dist_to_out else base_dist
+            target = (local_rank_id + send_dist) % nodes_per_machine
+            send_rank = machine_id * nodes_per_machine + target
+
+            reverse_dist_to_out = (local_rank_id - outside_slot) % nodes_per_machine
+            recv_dist = base_dist + 1 if base_dist >= reverse_dist_to_out else base_dist
+            source = (local_rank_id - recv_dist) % nodes_per_machine
+            recv_rank = machine_id * nodes_per_machine + source
+        yield [send_rank], [recv_rank]
+        index += 1

@@ -1,0 +1,256 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Static virtual-graph topology generators.
+
+API parity with the reference ``bluefog/common/topology_util.py`` (cites below
+are reference file:line). Every generator returns a ``networkx.DiGraph`` whose
+edge weights form the combination ("gossip") matrix ``W``: ``W[i, j]`` is the
+weight that rank ``j`` applies to the value received from rank ``i``. Rows of
+``W`` describe who rank ``i`` *sends* to; columns describe who rank ``j``
+*receives* from.
+
+On TPU these graphs are lowered to XLA ``ppermute`` schedules by
+:mod:`bluefog_tpu.parallel.plan`; the circulant structure of most generators
+(every rank's neighbor set is the same set of ring offsets) maps each offset
+onto a single ``collective_permute`` over the ICI mesh.
+"""
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import networkx as nx
+
+__all__ = [
+    "ExponentialTwoGraph",
+    "ExponentialGraph",
+    "SymmetricExponentialGraph",
+    "MeshGrid2DGraph",
+    "StarGraph",
+    "RingGraph",
+    "FullyConnectedGraph",
+    "IsTopologyEquivalent",
+    "IsRegularGraph",
+    "GetRecvWeights",
+    "GetSendWeights",
+    "isPowerOf",
+]
+
+
+def _circulant_graph(row: np.ndarray) -> nx.DiGraph:
+    """Build a circulant digraph from the row of weights for rank 0.
+
+    ``row[d]`` is the weight of the edge ``i -> (i + d) % size`` for every
+    rank ``i`` (``d = 0`` is the self loop). This is the common construction
+    behind the exponential / ring / fully-connected generators
+    (reference topology_util.py:81-87 builds the same matrix via np.roll).
+    """
+    size = row.shape[0]
+    mat = np.empty((size, size))
+    for i in range(size):
+        mat[i] = np.roll(row, i)
+    return nx.from_numpy_array(mat, create_using=nx.DiGraph)
+
+
+def isPowerOf(x: int, base: int) -> bool:
+    """True iff ``x == base ** k`` for some integer ``k >= 0``.
+
+    Integer-exact version of reference topology_util.py:90-96 (which uses
+    floating-point ``math.log`` and can misclassify large powers).
+    """
+    assert isinstance(base, int), "Base has to be a integer."
+    assert base > 1, "Base has to a interger larger than 1."
+    assert x > 0
+    while x % base == 0:
+        x //= base
+    return x == 1
+
+
+def ExponentialTwoGraph(size: int) -> nx.DiGraph:
+    """Each rank i sends to ranks i + 2**k (mod size), uniformly weighted.
+
+    Parity: reference topology_util.py:66-87. Out-neighbor offsets are
+    {1, 2, 4, ...} < size plus the self loop; weights are uniform over the
+    out-degree + self. On TPU every offset is one ``ppermute``; there are
+    ceil(log2(size)) of them.
+    """
+    assert size > 0
+    row = np.array(
+        [1.0 if i == 0 or (i & (i - 1)) == 0 else 0.0 for i in range(size)]
+    )
+    row /= row.sum()
+    return _circulant_graph(row)
+
+
+def ExponentialGraph(size: int, base: int = 2) -> nx.DiGraph:
+    """Each rank i sends to ranks at offsets that are powers of ``base``.
+
+    Parity: reference topology_util.py:99-125. This is the default topology
+    installed by ``bf.init()`` (reference common/basics.py:65-69).
+    """
+    assert size > 0
+    row = np.array(
+        [1.0 if i == 0 or isPowerOf(i, base) else 0.0 for i in range(size)]
+    )
+    row /= row.sum()
+    return _circulant_graph(row)
+
+
+def SymmetricExponentialGraph(size: int, base: int = 4) -> nx.DiGraph:
+    """Symmetric variant: offsets mirrored around size/2.
+
+    Parity: reference topology_util.py:128-157.
+    """
+    assert size > 0
+    row = np.zeros(size)
+    row[0] = 1.0
+    for i in range(1, size):
+        index = i if i <= size // 2 else size - i
+        if isPowerOf(index, base):
+            row[i] = 1.0
+    row /= row.sum()
+    return _circulant_graph(row)
+
+
+def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.DiGraph:
+    """2-D grid with Metropolis-Hastings weights.
+
+    Parity: reference topology_util.py:160-211. Edge weight between grid
+    neighbors i, j is 1 / max(deg(i), deg(j)) counting self loops; the self
+    weight absorbs the remainder so each row sums to 1 (doubly stochastic by
+    symmetry — "Hastings rule", arXiv:1702.05122 policy 1).
+    """
+    assert size > 0
+    if shape is None:
+        i = int(np.sqrt(size))
+        while size % i != 0:
+            i -= 1
+        shape = (i, size // i)
+    nrow, ncol = shape
+    assert size == nrow * ncol, "The shape doesn't match the size provided."
+
+    adj = np.zeros((size, size))
+    for i in range(size):
+        adj[i, i] = 1.0
+        if (i + 1) % ncol != 0:  # right neighbor within the same row
+            adj[i, i + 1] = adj[i + 1, i] = 1.0
+        if i + ncol < size:  # neighbor in the row below
+            adj[i, i + ncol] = adj[i + ncol, i] = 1.0
+
+    degree = [np.count_nonzero(adj[i]) for i in range(size)]
+    mat = np.zeros((size, size))
+    for i in range(size):
+        for j in np.nonzero(adj[i])[0]:
+            if i != j:
+                mat[i, j] = 1.0 / max(degree[i], degree[j])
+        mat[i, i] = 1.0 - mat[i].sum()
+    return nx.from_numpy_array(mat, create_using=nx.DiGraph)
+
+
+def StarGraph(size: int, center_rank: int = 0) -> nx.DiGraph:
+    """Bidirectional star centered on ``center_rank``.
+
+    Parity: reference topology_util.py:214-237.
+    """
+    assert size > 0
+    mat = np.zeros((size, size))
+    for i in range(size):
+        mat[i, i] = 1 - 1 / size
+        mat[center_rank, i] = 1 / size
+        mat[i, center_rank] = 1 / size
+    return nx.from_numpy_array(mat, create_using=nx.DiGraph)
+
+
+def RingGraph(size: int, connect_style: int = 0) -> nx.DiGraph:
+    """Ring topology; 0 = bidirectional, 1 = left only, 2 = right only.
+
+    Parity: reference topology_util.py:240-281.
+    """
+    assert size > 0
+    assert 0 <= connect_style <= 2, (
+        "connect_style has to be int between 0 and 2, where 0 for "
+        "bi-connection, 1 for left connection, 2 for right connection."
+    )
+    if size == 1:
+        return nx.from_numpy_array(np.array([[1.0]]), create_using=nx.DiGraph)
+    if size == 2:
+        return nx.from_numpy_array(
+            np.array([[0.5, 0.5], [0.5, 0.5]]), create_using=nx.DiGraph
+        )
+
+    row = np.zeros(size)
+    if connect_style == 0:
+        row[0] = row[1] = row[-1] = 1 / 3.0
+    elif connect_style == 1:
+        row[0] = row[-1] = 0.5
+    else:
+        row[0] = row[1] = 0.5
+    return _circulant_graph(row)
+
+
+def FullyConnectedGraph(size: int) -> nx.DiGraph:
+    """All-to-all with uniform 1/size weights.
+
+    Parity: reference topology_util.py:284-303.
+    """
+    assert size > 0
+    return _circulant_graph(np.full(size, 1.0 / size))
+
+
+def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:
+    """Weighted-adjacency equality (not isomorphism).
+
+    Parity: reference topology_util.py:23-37.
+    """
+    if topo1 is None or topo2 is None:
+        return False
+    if topo1.number_of_nodes() != topo2.number_of_nodes():
+        return False
+    if topo1.number_of_edges() != topo2.number_of_edges():
+        return False
+    a1 = nx.to_numpy_array(topo1).ravel()
+    a2 = nx.to_numpy_array(topo2).ravel()
+    return bool((a1 == a2).all())
+
+
+def IsRegularGraph(topo: nx.DiGraph) -> bool:
+    """True iff every node has the same (in+out) degree.
+
+    Parity: reference topology_util.py:306-312.
+    """
+    degree = topo.degree(0)
+    for rank in range(1, topo.number_of_nodes()):
+        if topo.degree(rank) != degree:
+            return False
+    return True
+
+
+def GetRecvWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {in_neighbor: weight}) for ``rank``.
+
+    Parity: reference topology_util.py:40-50. Receive weights live in column
+    ``rank`` of the combination matrix.
+    """
+    mat = nx.to_numpy_array(topo)
+    self_weight = 0.0
+    neighbor_weights: Dict[int, float] = {}
+    for src in topo.predecessors(rank):
+        if src == rank:
+            self_weight = float(mat[src, rank])
+        else:
+            neighbor_weights[src] = float(mat[src, rank])
+    return self_weight, neighbor_weights
+
+
+def GetSendWeights(topo: nx.DiGraph, rank: int) -> Tuple[float, Dict[int, float]]:
+    """(self_weight, {out_neighbor: weight}) for ``rank``.
+
+    Parity: reference topology_util.py:53-63.
+    """
+    mat = nx.to_numpy_array(topo)
+    self_weight = 0.0
+    neighbor_weights: Dict[int, float] = {}
+    for dst in topo.successors(rank):
+        if dst == rank:
+            self_weight = float(mat[rank, dst])
+        else:
+            neighbor_weights[dst] = float(mat[rank, dst])
+    return self_weight, neighbor_weights

@@ -1,0 +1,119 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Infer the reverse direction of a dynamic graph from per-rank peer lists.
+
+API parity with reference ``bluefog/torch/topology_util.py:22-108``. The
+reference implements these as collective ``allgather`` calls because each MPI
+process only knows its own peers; under single-controller SPMD the host
+already holds every rank's list, so the same inversion is pure numpy — no
+communication round at all.
+"""
+
+import collections
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "InferSourceFromDestinationRanks",
+    "InferDestinationFromSourceRanks",
+]
+
+
+def _check_ranks(rank_list: Sequence[Any], self_rank: int, size: int) -> Tuple[bool, str]:
+    # Validation parity: reference torch/topology_util.py:9-19.
+    for rank in rank_list:
+        if not isinstance(rank, (int, np.integer)):
+            return False, "contain element that is not integer."
+        if rank < 0 or rank >= size:
+            return False, "contain element that is not between 0 and size-1."
+    if len(set(rank_list)) != len(rank_list):
+        return False, "contain duplicated elements."
+    if self_rank in rank_list:
+        return False, "contain self rank."
+    return True, ""
+
+
+def _infer_topo(
+    ranks_per_rank: Sequence[Sequence[int]],
+    transpose: bool,
+    construct_adjacency_matrix: bool,
+):
+    size = len(ranks_per_rank)
+    adjacency = {i: sorted(lst) for i, lst in enumerate(ranks_per_rank)}
+
+    inverse = collections.defaultdict(list)
+    for src, adj in adjacency.items():
+        for dst in adj:
+            inverse[dst].append(src)
+    inferred = [inverse.get(r, []) for r in range(size)]
+
+    if not construct_adjacency_matrix:
+        return inferred, None
+
+    # Matrix construction parity (including the normalization quirk):
+    # reference torch/topology_util.py:102-108.
+    w = np.eye(size)
+    for src, adj in adjacency.items():
+        w[src, adj] = 1
+    if transpose:
+        w = w.T
+    return inferred, w / w.sum(axis=1)
+
+
+def InferSourceFromDestinationRanks(
+    dst_ranks: Union[Sequence[Sequence[int]], Sequence[int]],
+    construct_adjacency_matrix: bool = False,
+    *,
+    rank: Optional[int] = None,
+    size: Optional[int] = None,
+) -> Any:
+    """Who sends to me, given who everyone sends to.
+
+    Args:
+        dst_ranks: per-rank destination lists ``[[dst...] for each rank]``.
+            For reference-signature compatibility a single flat list is also
+            accepted together with ``rank``/``size`` (taken from the active
+            bluefog context when omitted), in which case the remaining ranks'
+            lists are assumed symmetric is NOT possible — a flat list without
+            the full picture raises.
+        construct_adjacency_matrix: also return the column-normalized W.
+        rank: if given, return only this rank's inferred list (reference
+            behavior); otherwise return the list for every rank.
+    """
+    per_rank = _normalize(dst_ranks, rank, size)
+    n = len(per_rank)
+    for r, lst in enumerate(per_rank):
+        ok, msg = _check_ranks(lst, r, n)
+        assert ok, f"The format of dst_ranks is wrong: {msg}"
+    inferred, w = _infer_topo(per_rank, False, construct_adjacency_matrix)
+    out = inferred[rank] if rank is not None else inferred
+    return (out, w) if construct_adjacency_matrix else out
+
+
+def InferDestinationFromSourceRanks(
+    src_ranks: Union[Sequence[Sequence[int]], Sequence[int]],
+    construct_adjacency_matrix: bool = False,
+    *,
+    rank: Optional[int] = None,
+    size: Optional[int] = None,
+) -> Any:
+    """Who I send to, given who everyone receives from. See
+    :func:`InferSourceFromDestinationRanks`."""
+    per_rank = _normalize(src_ranks, rank, size)
+    n = len(per_rank)
+    for r, lst in enumerate(per_rank):
+        ok, msg = _check_ranks(lst, r, n)
+        assert ok, f"The format of src_ranks is wrong: {msg}"
+    inferred, w = _infer_topo(per_rank, True, construct_adjacency_matrix)
+    out = inferred[rank] if rank is not None else inferred
+    return (out, w) if construct_adjacency_matrix else out
+
+
+def _normalize(ranks, rank, size) -> List[List[int]]:
+    if len(ranks) and isinstance(ranks[0], (list, tuple, np.ndarray)):
+        return [list(map(int, lst)) for lst in ranks]
+    raise ValueError(
+        "Expected per-rank lists [[...] for each rank]; a single rank's flat "
+        "list cannot determine the global topology under single-controller "
+        "SPMD. Pass every rank's list (e.g. from the dynamic generators)."
+    )

@@ -1,0 +1,68 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Virtual-rank -> TPU torus placement.
+
+The reference maps virtual graph ranks onto MPI processes and lets the
+network fabric route arbitrary peer pairs (MPI_Dist_graph_create_adjacent,
+reference common/mpi_context.cc:401-419). On TPU the fabric is a 2-D/3-D
+torus of ICI links, so *where* each virtual rank lives decides whether a
+gossip edge is one ICI hop or a multi-hop route. This module orders the
+device list so that the hot topologies ride short paths:
+
+- ring / one-peer schedules: virtual offset +-1 should be a physical torus
+  neighbor -> serpentine (boustrophedon) walk over the torus coordinates.
+- Exponential-2: offsets are powers of two; on a serpentine ring of an
+  ``R x C`` torus, offset ``C`` is one vertical hop, so the expensive middle
+  offsets also stay short.
+
+XLA lowers ``ppermute`` on its own; this placement only fixes the
+device-order input to ``Mesh`` so the permutes it emits are torus-friendly.
+"""
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["serpentine_device_order", "worker_device_order"]
+
+
+def serpentine_device_order(devices: Sequence) -> List:
+    """Order TPU devices in a serpentine walk over their (x, y[, z]) coords.
+
+    Consecutive devices in the returned list are physical torus neighbors
+    (including the wrap-around edge for even row counts), which makes the
+    virtual ring of :func:`bluefog_tpu.topology.RingGraph` — and the +-1
+    offsets of every one-peer schedule — single-hop on ICI.
+
+    Devices without coords (CPU/GPU test meshes) are returned unchanged.
+    """
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return list(devices)
+        coords.append(tuple(c))
+
+    ndim = len(coords[0])
+    # Sort by (z, y, x) then snake along x within each y-row, and along y
+    # within each z-plane, so the walk never jumps.
+    arr = sorted(zip(coords, devices), key=lambda cd: tuple(reversed(cd[0])))
+    rows = {}
+    for c, d in arr:
+        rows.setdefault(c[1:] if ndim > 1 else (), []).append((c, d))
+    ordered = []
+    row_keys = sorted(rows.keys(), key=lambda k: tuple(reversed(k)))
+    for i, k in enumerate(row_keys):
+        row = rows[k]
+        if i % 2 == 1:
+            row = list(reversed(row))
+        ordered.extend(d for _, d in row)
+    return ordered
+
+
+def worker_device_order(devices: Optional[Sequence] = None) -> List:
+    """Device order for the 1-D worker mesh used by the eager facade."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    return serpentine_device_order(devices)

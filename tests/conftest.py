@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU platform before jax imports.
+
+Mirrors the reference strategy of faking multi-node on one host
+(BLUEFOG_NODES_PER_MACHINE, reference common/mpi_context.cc:320-337): here a
+single host exposes 8 XLA CPU devices and meshes/submeshes are built over
+them. Set BLUEFOG_TEST_DEVICES to change the count.
+"""
+
+import os
+
+_NUM = os.environ.get("BLUEFOG_TEST_DEVICES", "8")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_NUM}"
+).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    return jax.devices("cpu")

@@ -1,0 +1,258 @@
+"""Topology toolkit tests.
+
+Mirrors the topology coverage of reference test/torch_basics_test.py (graph
+generators, equivalence, recv/send weights, infer helpers) plus schedule
+properties the compiled path relies on.
+"""
+
+import collections
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from bluefog_tpu import topology as tu
+
+
+ALL_SIZES = [1, 2, 3, 4, 7, 8, 12, 16]
+
+
+def _w(topo):
+    return nx.to_numpy_array(topo)
+
+
+@pytest.mark.parametrize("size", ALL_SIZES)
+@pytest.mark.parametrize(
+    "gen",
+    [
+        tu.ExponentialTwoGraph,
+        tu.ExponentialGraph,
+        lambda n: tu.SymmetricExponentialGraph(n, 4),
+        tu.MeshGrid2DGraph,
+        tu.StarGraph,
+        tu.RingGraph,
+        tu.FullyConnectedGraph,
+    ],
+)
+def test_generators_row_stochastic(gen, size):
+    w = _w(gen(size))
+    assert w.shape == (size, size)
+    np.testing.assert_allclose(w.sum(axis=1), np.ones(size), atol=1e-12)
+    assert (w >= 0).all()
+    # every rank keeps a self loop
+    assert (np.diag(w) > 0).all()
+
+
+def test_exponential_two_structure():
+    w = _w(tu.ExponentialTwoGraph(12))
+    # rank 0 sends to offsets {1, 2, 4, 8} and itself, uniformly
+    nz = np.nonzero(w[0])[0]
+    np.testing.assert_array_equal(nz, [0, 1, 2, 4, 8])
+    np.testing.assert_allclose(w[0, nz], 0.2)
+    # circulant: every row is a roll of row 0
+    for i in range(12):
+        np.testing.assert_allclose(w[i], np.roll(w[0], i))
+
+
+def test_exponential_graph_base3():
+    w = _w(tu.ExponentialGraph(28, base=3))
+    nz = set(np.nonzero(w[0])[0])
+    assert nz == {0, 1, 3, 9, 27}
+
+
+def test_meshgrid_doubly_stochastic():
+    for size, shape in [(6, None), (16, None), (6, (2, 3)), (12, (3, 4))]:
+        w = _w(tu.MeshGrid2DGraph(size, shape=shape))
+        np.testing.assert_allclose(w.sum(axis=0), np.ones(size), atol=1e-12)
+        np.testing.assert_allclose(w.sum(axis=1), np.ones(size), atol=1e-12)
+        np.testing.assert_allclose(w, w.T)
+
+
+def test_ring_styles():
+    w0 = _w(tu.RingGraph(8, connect_style=0))
+    assert set(np.nonzero(w0[0])[0]) == {0, 1, 7}
+    w1 = _w(tu.RingGraph(8, connect_style=1))
+    assert set(np.nonzero(w1[0])[0]) == {0, 7}
+    w2 = _w(tu.RingGraph(8, connect_style=2))
+    assert set(np.nonzero(w2[0])[0]) == {0, 1}
+
+
+def test_star_structure():
+    w = _w(tu.StarGraph(8, center_rank=2))
+    for i in range(8):
+        assert w[i, 2] > 0 and w[2, i] > 0
+
+
+def test_is_topology_equivalent():
+    assert tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.StarGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(8), tu.RingGraph(9))
+    assert not tu.IsTopologyEquivalent(None, tu.RingGraph(8))
+
+
+def test_is_regular():
+    assert tu.IsRegularGraph(tu.RingGraph(8))
+    assert tu.IsRegularGraph(tu.ExponentialTwoGraph(8))
+    assert not tu.IsRegularGraph(tu.StarGraph(8))
+
+
+def test_recv_send_weights():
+    topo = tu.ExponentialTwoGraph(8)
+    self_w, recv = tu.GetRecvWeights(topo, 3)
+    assert self_w == pytest.approx(0.25)
+    assert set(recv) == {2, 1, 7, 3 - 4 + 8}  # offsets -1,-2,-4 mod 8 => 2,1,7
+    assert all(v == pytest.approx(0.25) for v in recv.values())
+    self_w2, send = tu.GetSendWeights(topo, 3)
+    assert self_w2 == pytest.approx(0.25)
+    assert set(send) == {4, 5, 7}
+    # recv weights of rank j are the column j of W
+    w = _w(topo)
+    for src, val in recv.items():
+        assert w[src, 3] == pytest.approx(val)
+
+
+def test_power_of():
+    assert tu.isPowerOf(1, 2) and tu.isPowerOf(8, 2) and tu.isPowerOf(27, 3)
+    assert not tu.isPowerOf(6, 2)
+    # large power exactness (float log would fail around here)
+    assert tu.isPowerOf(3**30, 3)
+
+
+# ---------------------------------------------------------------------------
+# dynamic schedules
+# ---------------------------------------------------------------------------
+
+
+def _collect_round(gens, t):
+    sends = {}
+    recvs = {}
+    for r, g in enumerate(gens):
+        s, rv = next(g)
+        sends[r] = s
+        recvs[r] = rv
+    return sends, recvs
+
+
+@pytest.mark.parametrize("size", [4, 8, 12])
+def test_dynamic_one_peer_consistency(size):
+    topo = tu.ExponentialTwoGraph(size)
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
+    for t in range(12):
+        sends, recvs = _collect_round(gens, t)
+        # every send must appear in the destination's recv list, and vice versa
+        for r in range(size):
+            assert len(sends[r]) == 1
+            dst = sends[r][0]
+            assert r in recvs[dst]
+            for src in recvs[r]:
+                assert sends[src] == [r]
+        # edges must come from the base topology
+        for r in range(size):
+            assert sends[r][0] in [v for v in topo.successors(r) if v != r]
+
+
+def test_dynamic_one_peer_exp2_uniform_offset():
+    """For Exp-2 every rank picks the same offset each round (this is what
+    lets the compiled path use a single ppermute per step)."""
+    size = 8
+    topo = tu.ExponentialTwoGraph(size)
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
+    for t in range(6):
+        sends, _ = _collect_round(gens, t)
+        offsets = {(sends[r][0] - r) % size for r in range(size)}
+        assert len(offsets) == 1
+        assert offsets.pop() == 2 ** (t % 3)
+
+
+@pytest.mark.parametrize("world,local", [(16, 4), (24, 4)])
+def test_inner_outer_ring_is_permutation(world, local):
+    gens = [
+        tu.GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+        for r in range(world)
+    ]
+    for t in range(10):
+        sends, recvs = _collect_round(gens, t)
+        all_dsts = [sends[r][0] for r in range(world)]
+        assert sorted(all_dsts) == list(range(world))  # a permutation
+        for r in range(world):
+            assert recvs[r] == [all_dsts.index(r)] or all_dsts[recvs[r][0]] == r
+
+
+@pytest.mark.parametrize("world,local", [(16, 4), (32, 8)])
+def test_inner_outer_expo2_is_permutation(world, local):
+    gens = [
+        tu.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+        for r in range(world)
+    ]
+    for t in range(12):
+        sends, recvs = _collect_round(gens, t)
+        all_dsts = [sends[r][0] for r in range(world)]
+        assert sorted(all_dsts) == list(range(world))
+        for r in range(world):
+            src = recvs[r][0]
+            assert sends[src] == [r]
+
+
+def test_exp2_machine_schedule():
+    world, local = 16, 4
+    gens = {
+        r: tu.GetExp2DynamicSendRecvMachineRanks(world, local, r, r % local)
+        for r in range(world)
+    }
+    s, rv = next(gens[0])
+    assert s == [1] and rv == [3]  # machine 0 -> 1, recv from 3 (4 machines)
+    s, rv = next(gens[0])
+    assert s == [2] and rv == [2]
+
+
+# ---------------------------------------------------------------------------
+# infer helpers
+# ---------------------------------------------------------------------------
+
+
+def test_infer_source_from_destination():
+    dst = [[1], [2], [3], [0]]  # directed ring on 4 ranks
+    src = tu.InferSourceFromDestinationRanks(dst)
+    assert src == [[3], [0], [1], [2]]
+    src3, w = tu.InferSourceFromDestinationRanks(
+        dst, construct_adjacency_matrix=True, rank=3
+    )
+    assert src3 == [2]
+    assert w.shape == (4, 4)
+
+
+def test_infer_destination_from_source():
+    src = [[1, 2], [0], [0], []]
+    dst = tu.InferDestinationFromSourceRanks(src)
+    assert dst == [[1, 2], [0], [0], []]
+
+
+def test_infer_validation():
+    with pytest.raises(AssertionError):
+        tu.InferSourceFromDestinationRanks([[0], [0], [0], [0]])  # self rank
+    with pytest.raises(ValueError):
+        tu.InferSourceFromDestinationRanks([1, 2, 3])  # flat list
+
+
+def test_serpentine_order_passthrough():
+    class FakeDev:
+        pass
+
+    devs = [FakeDev() for _ in range(4)]
+    assert tu.serpentine_device_order(devs) == devs
+
+
+def test_serpentine_order_torus():
+    class FakeDev:
+        def __init__(self, coords):
+            self.coords = coords
+
+        def __repr__(self):
+            return f"D{self.coords}"
+
+    devs = [FakeDev((x, y, 0)) for y in range(2) for x in range(4)]
+    ordered = tu.worker_device_order(devs)
+    coords = [d.coords for d in ordered]
+    # serpentine: consecutive coords differ by one hop
+    for a, b in zip(coords, coords[1:]):
+        assert sum(abs(i - j) for i, j in zip(a, b)) == 1
