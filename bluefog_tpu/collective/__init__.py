@@ -1,0 +1,40 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Collective layer: topology-aware gossip collectives compiled to XLA.
+
+Two levels:
+
+- :mod:`bluefog_tpu.collective.plan` — host-side lowering of a (possibly
+  dynamic, weighted, directed) virtual graph topology into a ``CommPlan``:
+  rounds of partial permutations plus receiver-side weight vectors. This is
+  the TPU-native replacement for the reference's MPI graph communicator and
+  per-op negotiation (reference ``common/mpi_controller.cc:419-551``).
+- :mod:`bluefog_tpu.collective.inner` — functions used *inside* ``shard_map``
+  over a worker mesh axis: ``neighbor_allreduce``, ``allreduce``,
+  ``allgather``, ``neighbor_allgather``, ``broadcast``, ``pair_gossip``,
+  ``barrier``. The weighted combine happens inside the compiled program
+  (replacing the torch callback in reference ``torch/mpi_ops.cc:99-164``).
+"""
+
+from bluefog_tpu.collective.plan import (
+    CommPlan,
+    CommRound,
+    SchedulePlan,
+    plan_from_topology,
+    plan_from_weights,
+    plan_from_matrix,
+    schedule_from_dynamic,
+    check_send_recv_symmetry,
+)
+from bluefog_tpu.collective import inner
+
+__all__ = [
+    "CommPlan",
+    "CommRound",
+    "SchedulePlan",
+    "plan_from_topology",
+    "plan_from_weights",
+    "plan_from_matrix",
+    "schedule_from_dynamic",
+    "check_send_recv_symmetry",
+    "inner",
+]

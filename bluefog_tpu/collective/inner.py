@@ -1,0 +1,225 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Collective ops for use *inside* ``shard_map`` over a worker mesh axis.
+
+These are the TPU-native bodies of every BlueFog collective: the reference's
+MPI/NCCL controller calls (``common/mpi_controller.cc``) become
+``lax.ppermute`` / ``lax.psum`` / ``lax.all_gather`` on a named mesh axis,
+and the weighted averaging that the reference performs in a torch callback
+(``torch/mpi_ops.cc:99-164``) is fused into the compiled program.
+
+Every function takes a per-worker array (the shard_map block) plus an
+``axis_name``; plans/schedules are static arguments lowered by
+:mod:`bluefog_tpu.collective.plan`.
+"""
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
+
+__all__ = [
+    "weighted_combine",
+    "neighbor_allreduce",
+    "neighbor_allreduce_step",
+    "neighbor_allgather",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_step",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "pair_gossip",
+    "barrier",
+]
+
+
+def _weight_dtype(x: jnp.ndarray) -> jnp.dtype:
+    """Averaging weights should not up-promote bf16 activations, but integer
+    inputs must be averaged in float (the reference only ever averages float
+    tensors; we make the int case well-defined instead of truncating)."""
+    return x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
+
+
+def weighted_combine(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndarray:
+    """``y_j = self_w[j] * x_j + sum_r recv_w[r][j] * ppermute_r(x)_j``.
+
+    One ``ppermute`` per plan round; receivers scale what they got by their
+    entry in the round's weight vector (a tiny traced constant indexed by
+    ``axis_index``). Partial permutations deliver zeros to non-destinations,
+    whose weight entry is also zero, so irregular graphs need no masking.
+    """
+    wdt = _weight_dtype(x)
+    idx = lax.axis_index(axis_name)
+    xw = x.astype(wdt)
+    y = xw * jnp.asarray(plan.self_weights, dtype=wdt)[idx]
+    for rnd in plan.rounds:
+        recv = lax.ppermute(xw, axis_name, rnd.perm)
+        y = y + recv * jnp.asarray(rnd.recv_weights, dtype=wdt)[idx]
+    return y
+
+
+def neighbor_allreduce(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndarray:
+    """Weighted neighbor averaging over a static topology plan.
+
+    TPU-native form of reference ``neighbor_allreduce``
+    (``torch/mpi_ops.py:534-586`` + ``common/mpi_controller.cc:419-551``):
+    the graph-communicator exchange is the plan's ppermute rounds and the
+    combine is in-program.
+    """
+    return weighted_combine(x, plan, axis_name)
+
+
+def neighbor_allreduce_step(
+    x: jnp.ndarray, step: jnp.ndarray, schedule: SchedulePlan, axis_name: str
+) -> jnp.ndarray:
+    """Dynamic-topology neighbor averaging selected by step index.
+
+    ``lax.switch`` over the schedule period replaces the reference's
+    per-iteration Isend/Irecv negotiation (``mpi_controller.cc:458-506``);
+    peers change every step with zero retracing and zero host round-trips.
+    """
+    branches = [
+        functools.partial(weighted_combine, plan=p, axis_name=axis_name)
+        for p in schedule.plans
+    ]
+    if len(branches) == 1:
+        return branches[0](x)
+    return lax.switch(step % schedule.period, branches, x)
+
+
+def neighbor_allgather(
+    x: jnp.ndarray, plan: CommPlan, axis_name: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Collect raw (unweighted) in-neighbor values.
+
+    Reference ``neighbor_allgather`` returns a per-rank concatenation of
+    in-neighbor tensors ordered by rank (``mpi_controller.cc:282-361``;
+    order asserted by reference tests torch_ops_test.py:1116-1286). Under
+    SPMD every rank must produce the same shape, so the TPU-native layout is
+    ``[max_in_degree, *x.shape]`` plus a boolean validity mask
+    ``[max_in_degree]``; rows are the in-neighbors ascending, zero-padded
+    for ranks with fewer in-neighbors. The eager facade slices the padding
+    off per rank.
+    """
+    idx = lax.axis_index(axis_name)
+    received = [lax.ppermute(x, axis_name, rnd.perm) for rnd in plan.rounds]
+    if not received:
+        empty = jnp.zeros((0,) + x.shape, dtype=x.dtype)
+        return empty, jnp.zeros((0,), dtype=bool)
+    stacked = jnp.stack(received)  # [rounds, *shape]
+    slots = jnp.asarray(plan.gather_slots())[idx]  # [max_in_degree]
+    mask = slots >= 0
+    gathered = jnp.take(stacked, jnp.clip(slots, 0), axis=0)
+    gathered = jnp.where(
+        mask.reshape((-1,) + (1,) * x.ndim), gathered, jnp.zeros_like(gathered)
+    )
+    return gathered, mask
+
+
+def hierarchical_neighbor_allreduce(
+    x: jnp.ndarray,
+    machine_plan: CommPlan,
+    machine_axis: str,
+    local_axis: str,
+) -> jnp.ndarray:
+    """Machine-level gossip: local average, then machine-graph combine.
+
+    Reference three-step dance — local ``MPI_Allreduce``, rank-0 machine
+    exchange, local ``MPI_Bcast``, then divide by local_size in the callback
+    (``mpi_controller.cc:507-541``, ``mpi_ops.cc:133-137``) — becomes a
+    ``psum`` over the intra-host mesh axis followed by the machine plan's
+    ppermute rounds over the cross-host axis; the broadcast is implicit
+    because every local rank runs the same machine-axis combine.
+    """
+    local_size = lax.psum(jnp.ones((), dtype=jnp.float32), local_axis)
+    local_sum = lax.psum(x, local_axis)
+    combined = weighted_combine(local_sum, machine_plan, machine_axis)
+    return combined / local_size.astype(combined.dtype)
+
+
+def hierarchical_neighbor_allreduce_step(
+    x: jnp.ndarray,
+    step: jnp.ndarray,
+    machine_schedule: SchedulePlan,
+    machine_axis: str,
+    local_axis: str,
+) -> jnp.ndarray:
+    """Dynamic machine-topology variant (one-peer Exp2 at machine level,
+    :func:`bluefog_tpu.topology.GetExp2DynamicSendRecvMachineRanks`)."""
+    local_size = lax.psum(jnp.ones((), dtype=jnp.float32), local_axis)
+    local_sum = lax.psum(x, local_axis)
+    combined = neighbor_allreduce_step(local_sum, step, machine_schedule, machine_axis)
+    return combined / local_size.astype(combined.dtype)
+
+
+def allreduce(x: jnp.ndarray, axis_name: str, average: bool = True) -> jnp.ndarray:
+    """Classic allreduce = ``psum`` (reference ``mpi_controller.cc:169-191``)."""
+    if not average:
+        return lax.psum(x, axis_name)
+    wdt = _weight_dtype(x)
+    n = lax.psum(jnp.ones((), dtype=wdt), axis_name)
+    return lax.psum(x.astype(wdt), axis_name) / n
+
+
+def allgather(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Concatenate every rank's block along dim 0
+    (reference ``mpi_controller.cc:136-167`` semantics)."""
+    return lax.all_gather(x, axis_name, tiled=True)
+
+
+def broadcast(x: jnp.ndarray, root_rank: int, axis_name: str) -> jnp.ndarray:
+    """Every rank gets the root's value.
+
+    Lowered as mask-and-psum — a single XLA collective that rides ICI; the
+    reference uses ``MPI_Bcast`` (``mpi_controller.cc:193-213``).
+    """
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def pair_gossip(
+    x: jnp.ndarray,
+    pairs: Tuple[Tuple[int, int], ...],
+    axis_name: str,
+    self_weight: Optional[float] = None,
+    pair_weight: Optional[float] = None,
+) -> jnp.ndarray:
+    """Average with exactly one partner (reference ``MPI_Sendrecv`` gossip,
+    ``mpi_controller.cc:747-773``; torch wrapper mpi_ops.py:838-899).
+
+    ``pairs`` lists each exchanging pair once, e.g. ``((0, 1), (2, 3))``;
+    both directions are generated. Ranks not in any pair keep their value.
+    Default weights are the reference's plain average (1/2, 1/2).
+    """
+    size_perm = []
+    in_pair = set()
+    for a, b in pairs:
+        assert a != b, "pair_gossip partner must differ from self"
+        assert a not in in_pair and b not in in_pair, (
+            "pair_gossip: each rank may appear in at most one pair"
+        )
+        in_pair.update((a, b))
+        size_perm += [(a, b), (b, a)]
+    if self_weight is None:
+        self_weight = 0.5
+    if pair_weight is None:
+        pair_weight = 0.5
+
+    wdt = _weight_dtype(x)
+    idx = lax.axis_index(axis_name)
+    xw = x.astype(wdt)
+    recv = lax.ppermute(xw, axis_name, size_perm)
+    paired = jnp.isin(idx, jnp.asarray(sorted(in_pair), dtype=idx.dtype)) if in_pair else jnp.zeros((), bool)
+    gossiped = xw * jnp.asarray(self_weight, wdt) + recv * jnp.asarray(pair_weight, wdt)
+    return jnp.where(paired, gossiped, xw)
+
+
+def barrier(axis_name: str) -> jnp.ndarray:
+    """A full synchronization point: psum of a unit scalar. The eager facade
+    blocks on the result (reference ``MPI_Barrier``, mpi_controller.cc:1185)."""
+    return lax.psum(jnp.ones((), dtype=jnp.int32), axis_name)

@@ -1,0 +1,391 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Lower virtual-graph topologies to static XLA communication plans.
+
+The reference negotiates every operation at runtime: ranks submit requests, a
+coordinator matches them, and an MPI graph communicator (or tagged
+Isend/Irecv) moves the data (reference ``common/operations.cc:853-1101``,
+``common/mpi_controller.cc:419-551``). On TPU none of that machinery is
+needed: the topology is known on the single controller, so we lower it *once*
+to a ``CommPlan`` — a short sequence of partial permutations
+(``lax.ppermute``) plus per-round receiver-side weight vectors — and the
+weighted combine compiles into the step function.
+
+Decomposition: every directed edge ``(src, dst)`` has a ring offset
+``(dst - src) % size``. All edges that share one offset form a partial
+permutation (sources are distinct, hence destinations too), so grouping by
+offset yields one ``ppermute`` per distinct offset. For the circulant
+topologies (Exp2, ring, fully-connected) each round is a *full* permutation
+— a single ``collective_permute`` riding ICI — and Exp-2 needs only
+``log2(N)`` rounds.
+
+Weighting is receiver-side: after round ``r`` each rank multiplies what it
+received by ``recv_weights[r][self]``. Because every rank receives from at
+most one source per round, an arbitrary weight matrix ``W`` (directed,
+non-symmetric, column- or row-stochastic — anything) is expressible this
+way; the reference's separate "dst-weighted scaled send" buffers
+(``mpi_controller.cc:462-505``, ``tensor_queue.h:103-106``) collapse into
+the same per-edge weights.
+
+Dynamic one-peer topologies are periodic, so they lower to a
+``SchedulePlan``: one ``CommPlan`` per period step, selected at trace time
+by ``lax.switch`` on the step index — no recompilation when peers change.
+"""
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import networkx as nx
+
+__all__ = [
+    "CommRound",
+    "CommPlan",
+    "SchedulePlan",
+    "plan_from_matrix",
+    "plan_from_topology",
+    "plan_from_weights",
+    "schedule_from_dynamic",
+    "check_send_recv_symmetry",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CommRound:
+    """One ``ppermute`` round: a partial permutation and receiver weights.
+
+    ``perm`` is the ``lax.ppermute``-style list of ``(src, dst)`` pairs;
+    ``recv_weights[j]`` is the factor rank ``j`` applies to the value it
+    receives this round (0.0 where ``j`` is not a destination).
+    """
+
+    perm: Tuple[Tuple[int, int], ...]
+    recv_weights: Tuple[float, ...]
+
+    @property
+    def sources(self) -> Tuple[int, ...]:
+        return tuple(s for s, _ in self.perm)
+
+    @property
+    def destinations(self) -> Tuple[int, ...]:
+        return tuple(d for _, d in self.perm)
+
+
+@dataclasses.dataclass(frozen=True)
+class CommPlan:
+    """A complete static communication plan for one gossip step.
+
+    The combine computed by :func:`bluefog_tpu.collective.inner.weighted_combine`
+    is ``y_j = self_weights[j] * x_j + sum_r recv_weights[r][j] * recv_r(j)``
+    — the same math as the reference callback (``torch/mpi_ops.cc:99-164``)
+    but inside the compiled program.
+    """
+
+    size: int
+    self_weights: Tuple[float, ...]
+    rounds: Tuple[CommRound, ...]
+
+    @property
+    def in_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
+        """Sorted in-neighbor list per rank (ascending, reference order —
+        reference tests check neighbor_allgather output is rank-ordered)."""
+        ins: List[List[int]] = [[] for _ in range(self.size)]
+        for rnd in self.rounds:
+            for s, d in rnd.perm:
+                ins[d].append(s)
+        return tuple(tuple(sorted(lst)) for lst in ins)
+
+    @property
+    def out_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
+        outs: List[List[int]] = [[] for _ in range(self.size)]
+        for rnd in self.rounds:
+            for s, d in rnd.perm:
+                outs[s].append(d)
+        return tuple(tuple(sorted(lst)) for lst in outs)
+
+    @property
+    def max_in_degree(self) -> int:
+        return max((len(n) for n in self.in_neighbors), default=0)
+
+    def gather_slots(self) -> np.ndarray:
+        """[size, max_in_degree] int32: for each rank, which *round* delivered
+        its k-th (rank-ascending) in-neighbor; -1 pads ranks with fewer
+        in-neighbors. Used by neighbor_allgather to reorder round-stacked
+        receives into the reference's rank-ordered layout."""
+        src_round: List[Dict[int, int]] = [dict() for _ in range(self.size)]
+        for r, rnd in enumerate(self.rounds):
+            for s, d in rnd.perm:
+                src_round[d][s] = r
+        out = np.full((self.size, max(self.max_in_degree, 1)), -1, np.int32)
+        for j, srcs in enumerate(self.in_neighbors):
+            for k, s in enumerate(srcs):
+                out[j, k] = src_round[j][s]
+        return out
+
+    def weight_matrix(self) -> np.ndarray:
+        """Reconstruct the effective combine matrix ``W`` (W[i, j] = weight
+        rank j applies to rank i's value). For testing/inspection."""
+        w = np.zeros((self.size, self.size))
+        for j in range(self.size):
+            w[j, j] = self.self_weights[j]
+        for rnd in self.rounds:
+            for s, d in rnd.perm:
+                w[s, d] = rnd.recv_weights[d]
+        return w
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulePlan:
+    """A periodic sequence of :class:`CommPlan` for dynamic topologies.
+
+    All plans share one ``size``; step ``t`` uses ``plans[t % period]``.
+    The compiled selector is ``lax.switch`` over the period — the Exp-2
+    one-peer schedule has period ``log2(N)``, so the trace contains that
+    many tiny branches and never retraces when the peer set moves.
+    """
+
+    plans: Tuple[CommPlan, ...]
+
+    @property
+    def period(self) -> int:
+        return len(self.plans)
+
+    @property
+    def size(self) -> int:
+        return self.plans[0].size
+
+    @property
+    def max_in_degree(self) -> int:
+        return max(p.max_in_degree for p in self.plans)
+
+
+def plan_from_matrix(
+    w: np.ndarray, edges: Optional[Iterable[Tuple[int, int]]] = None
+) -> CommPlan:
+    """Build a plan from a combine matrix ``W`` (``W[i, j]`` = weight rank
+    ``j`` applies to rank ``i``'s value; diagonal = self weights).
+
+    Edges default to the off-diagonal nonzeros; pass ``edges`` explicitly to
+    keep declared-but-zero-weighted links in the communication pattern (a
+    zero src weight must not shrink neighbor_allgather membership). Edges
+    are grouped by ring offset ``(j - i) % size`` into partial permutations.
+    """
+    w = np.asarray(w, dtype=np.float64)
+    size = w.shape[0]
+    assert w.shape == (size, size), "weight matrix must be square"
+
+    if edges is None:
+        edges = zip(*np.nonzero(w))
+    by_offset: Dict[int, List[Tuple[int, int]]] = {}
+    for i, j in edges:
+        if i == j:
+            continue
+        by_offset.setdefault((j - i) % size, []).append((int(i), int(j)))
+
+    rounds = []
+    for offset in sorted(by_offset):
+        perm = tuple(sorted(by_offset[offset]))
+        weights = [0.0] * size
+        for s, d in perm:
+            weights[d] = float(w[s, d])
+        rounds.append(CommRound(perm=perm, recv_weights=tuple(weights)))
+
+    return CommPlan(
+        size=size,
+        self_weights=tuple(float(w[i, i]) for i in range(size)),
+        rounds=tuple(rounds),
+    )
+
+
+def plan_from_topology(topo: nx.DiGraph, weighted: bool = True) -> CommPlan:
+    """Lower a static ``networkx.DiGraph`` topology to a plan.
+
+    ``weighted=True`` uses the graph's edge weights (the generators produce
+    doubly-stochastic W); ``weighted=False`` reproduces the reference's
+    uniform-average default (``mpi_ops.py:500-505``): every rank combines
+    itself and its in-neighbors with ``1 / (in_degree + 1)``.
+    """
+    w = nx.to_numpy_array(topo).astype(np.float64)
+    size = w.shape[0]
+    edges = [(i, j) for i, j in topo.edges() if i != j]
+    if not weighted:
+        u = np.zeros_like(w)
+        in_lists: Dict[int, List[int]] = {j: [] for j in range(size)}
+        for i, j in edges:
+            in_lists[j].append(i)
+        for j in range(size):
+            uniform = 1.0 / (len(in_lists[j]) + 1)
+            u[j, j] = uniform
+            for i in in_lists[j]:
+                u[i, j] = uniform
+        w = u
+    return plan_from_matrix(w, edges=edges)
+
+
+def _normalize_per_rank(
+    size: int,
+    value: Union[Dict[int, Dict[int, float]], Sequence[Dict[int, float]], Sequence[Sequence[int]], None],
+) -> Optional[List[Dict[int, float]]]:
+    """Normalize per-rank weight specs to ``[ {peer: weight} ] * size``.
+
+    Accepts a list/tuple indexed by rank or a dict keyed by rank; each entry
+    is a ``{peer: weight}`` dict or a bare peer list (weights default 1.0,
+    matching the reference's list form of dst_weights, mpi_ops.py:492-494).
+    """
+    if value is None:
+        return None
+    if isinstance(value, dict):
+        per_rank: List = [value.get(r, {}) for r in range(size)]
+    else:
+        per_rank = list(value)
+        assert len(per_rank) == size, (
+            f"per-rank weight spec must have one entry per rank "
+            f"(got {len(per_rank)}, size {size})"
+        )
+    out: List[Dict[int, float]] = []
+    for entry in per_rank:
+        if isinstance(entry, dict):
+            out.append({int(k): float(v) for k, v in entry.items()})
+        else:
+            out.append({int(k): 1.0 for k in entry})
+    return out
+
+
+def check_send_recv_symmetry(
+    src_per_rank: Sequence[Dict[int, float]],
+    dst_per_rank: Sequence[Dict[int, float]],
+) -> None:
+    """Verify the declared send pattern is the transpose of the recv pattern.
+
+    TPU-native equivalent of the reference's collective topology check, which
+    allgathers a send/recv boolean matrix and compares it with its transpose
+    (``mpi_controller.cc:363-417``); here the controller holds both sides, so
+    the check is a host-side set comparison.
+    """
+    sends = {(i, j) for i, dsts in enumerate(dst_per_rank) for j in dsts}
+    recvs = {(i, j) for j, srcs in enumerate(src_per_rank) for i in srcs}
+    if sends != recvs:
+        missing_recv = sorted(sends - recvs)
+        missing_send = sorted(recvs - sends)
+        raise ValueError(
+            "Send/recv neighbor pattern mismatch (topology check failed): "
+            f"declared sends with no matching recv: {missing_recv[:8]}; "
+            f"declared recvs with no matching send: {missing_send[:8]}."
+        )
+
+
+def plan_from_weights(
+    size: int,
+    self_weight: Union[float, Sequence[float]],
+    src_weights: Union[Dict[int, Dict[int, float]], Sequence[Dict[int, float]]],
+    dst_weights: Union[Dict[int, Dict[int, float]], Sequence, None] = None,
+    enable_topo_check: bool = True,
+) -> CommPlan:
+    """Build a plan from explicit per-rank weights (the dynamic-graph path).
+
+    Mirrors the reference argument contract (``mpi_ops.py:479-530``) lifted
+    to single-controller form: ``src_weights[j]`` is rank ``j``'s
+    ``{in_neighbor: weight}`` dict, ``dst_weights[i]`` rank ``i``'s
+    ``{out_neighbor: scale}`` dict (or bare list, scale 1.0). When
+    ``dst_weights`` is given the value rank ``j`` combines from rank ``i``
+    is scaled by *both* sides — effective ``W[i, j] = dst_w_i[j] *
+    src_w_j[i]`` — exactly what the reference computes with scaled sends
+    plus the receiver callback.
+    """
+    srcs = _normalize_per_rank(size, src_weights)
+    assert srcs is not None, "src_weights is required"
+    dsts = _normalize_per_rank(size, dst_weights)
+
+    if isinstance(self_weight, (int, float)):
+        self_w = [float(self_weight)] * size
+    else:
+        self_w = [float(v) for v in self_weight]
+        assert len(self_w) == size
+
+    if dsts is not None and enable_topo_check:
+        check_send_recv_symmetry(srcs, dsts)
+
+    w = np.zeros((size, size))
+    edges: List[Tuple[int, int]] = []
+    for j in range(size):
+        w[j, j] = self_w[j]
+        for i, wt in srcs[j].items():
+            assert 0 <= i < size and i != j, (
+                f"src_weights for rank {j} has invalid in-neighbor {i}"
+            )
+            scale = dsts[i].get(j, 1.0) if dsts is not None else 1.0
+            w[i, j] = wt * scale
+            edges.append((i, j))
+    return plan_from_matrix(w, edges=edges)
+
+
+def schedule_from_dynamic(
+    size: int,
+    make_iterator,
+    period: Optional[int] = None,
+    self_weight: Optional[float] = None,
+    uniform: bool = True,
+) -> SchedulePlan:
+    """Lower a reference-style dynamic generator to a periodic schedule.
+
+    ``make_iterator(rank)`` must return the per-rank infinite iterator of
+    ``([send_ranks], [recv_ranks])`` (the generators in
+    :mod:`bluefog_tpu.topology.dynamic`). The period is auto-detected by
+    replaying the iterators until the full send-pattern sequence repeats
+    (bounded search), or can be given explicitly.
+
+    Each step becomes a uniform-average plan: rank ``j`` combines itself and
+    its ``recv_ranks`` with weight ``1 / (len(recv) + 1)`` — the weight
+    policy the reference examples use for one-peer schedules
+    (e.g. dynamic-topology averaging in the benchmark driver).
+    ``uniform=False`` instead builds a mass-conserving (push-sum style)
+    matrix: each *sender* keeps ``self_weight`` and splits the remaining
+    ``1 - self_weight`` equally over its destinations, so every column of
+    the send pattern sums to 1 regardless of receiver in-degree.
+    """
+    iters = [make_iterator(r) for r in range(size)]
+    max_period = period or 4 * size + 8
+
+    steps: List[Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...]] = []
+    for _ in range(max_period):
+        step = tuple(
+            (tuple(send), tuple(recv))
+            for send, recv in (next(it) for it in iters)
+        )
+        steps.append(step)
+    if period is None:
+        period = _detect_period(steps)
+        steps = steps[:period]
+
+    plans = []
+    for step in steps:
+        dst_per_rank = [{d: 1.0 for d in send} for send, _ in step]
+        src_per_rank = [{s: 1.0 for s in recv} for _, recv in step]
+        check_send_recv_symmetry(src_per_rank, dst_per_rank)
+        w = np.zeros((size, size))
+        edges = [(i, j) for j, (_, recv) in enumerate(step) for i in recv]
+        if uniform:
+            for j, (_, recv) in enumerate(step):
+                wt = 1.0 / (len(recv) + 1)
+                w[j, j] = wt
+                for i in recv:
+                    w[i, j] = wt
+        else:
+            sw = 0.5 if self_weight is None else self_weight
+            for i, (send, _) in enumerate(step):
+                if not send:
+                    w[i, i] = 1.0
+                else:
+                    w[i, i] = sw
+                    for j in send:
+                        w[i, j] = (1.0 - sw) / len(send)
+        plans.append(plan_from_matrix(w, edges=edges))
+    return SchedulePlan(plans=tuple(plans))
+
+
+def _detect_period(steps: Sequence) -> int:
+    """Smallest p with steps[t] == steps[t+p] over the observed window."""
+    n = len(steps)
+    for p in range(1, n // 2 + 1):
+        if all(steps[t] == steps[t + p] for t in range(n - p)):
+            return p
+    return n

@@ -8,7 +8,7 @@ API parity with the dynamic generators in the reference
 TPU-native note: these schedules are *periodic* — a rank's sequence of peers
 repeats with a small period (e.g. log2(N) for Exponential-2). The compiled
 path therefore never consumes these iterators inside a step; instead
-:mod:`bluefog_tpu.parallel.plan` extracts the full period once as a static
+:mod:`bluefog_tpu.collective.plan` extracts the full period once as a static
 permutation table and selects the round with ``lax.switch`` on the step index
 (no retrace, no host round-trip). The iterators remain the user-facing,
 reference-compatible way to drive the eager API and the optimizers'

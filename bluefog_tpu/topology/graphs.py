@@ -9,7 +9,7 @@ weight that rank ``j`` applies to the value received from rank ``i``. Rows of
 *receives* from.
 
 On TPU these graphs are lowered to XLA ``ppermute`` schedules by
-:mod:`bluefog_tpu.parallel.plan`; the circulant structure of most generators
+:mod:`bluefog_tpu.collective.plan`; the circulant structure of most generators
 (every rank's neighbor set is the same set of ring offsets) maps each offset
 onto a single ``collective_permute`` over the ICI mesh.
 """

@@ -1,9 +1,15 @@
-"""Test harness: force an 8-device virtual CPU platform before jax imports.
+"""Test harness: force an 8-device virtual CPU platform.
 
 Mirrors the reference strategy of faking multi-node on one host
 (BLUEFOG_NODES_PER_MACHINE, reference common/mpi_context.cc:320-337): here a
 single host exposes 8 XLA CPU devices and meshes/submeshes are built over
 them. Set BLUEFOG_TEST_DEVICES to change the count.
+
+Note: the ambient environment may import jax at interpreter startup (TPU
+platform plugins via sitecustomize), so plain env-var mutation here can be
+too late for JAX_PLATFORMS. ``jax.config.update`` works as long as no
+backend has been initialized yet; XLA_FLAGS is read lazily at CPU backend
+init, so setting it here is still effective.
 """
 
 import os
@@ -13,13 +19,24 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + f" --xla_force_host_platform_device_count={_NUM}"
 ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Pick the test platform. The ambient environment exports JAX_PLATFORMS for
+# its TPU plugin, so a plain setdefault would never select CPU; but a user
+# who *explicitly* chose a non-ambient platform should be honored. Rule:
+# BLUEFOG_TEST_PLATFORM wins; otherwise any JAX_PLATFORMS other than the
+# ambient TPU plugin value ("axon") is kept; otherwise force cpu.
+_ambient = os.environ.get("JAX_PLATFORMS", "")
+_platform = os.environ.get(
+    "BLUEFOG_TEST_PLATFORM", _ambient if _ambient not in ("", "axon") else "cpu"
+)
+os.environ["JAX_PLATFORMS"] = _platform
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     return jax.devices("cpu")
