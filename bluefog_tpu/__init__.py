@@ -1,19 +1,203 @@
 # Copyright 2026. Licensed under the Apache License, Version 2.0.
 """bluefog_tpu: a TPU-native decentralized (gossip) training framework.
 
-Capability parity with BlueFog (reference at /root/reference) re-designed for
-JAX/XLA SPMD over TPU meshes: neighbor collectives are ``ppermute`` schedules
-over ICI, window-style asynchronous algorithms are buffered step-synchronous
-neighbor state, and the optimizer wrappers drive pjit-compiled train steps.
+Capability parity with BlueFog (reference at /root/reference) re-designed
+for JAX/XLA SPMD over TPU meshes: neighbor collectives are ``ppermute``
+schedules over ICI, window-style asynchronous algorithms are buffered
+step-synchronous neighbor state, and the optimizer wrappers drive
+pjit-compiled train steps.
 
-The user-facing facade mirrors ``bluefog.torch``::
+The user-facing facade mirrors ``bluefog.torch`` lifted to the
+single-controller model — distributed values are stacked "worker arrays"
+with one leading slot per worker::
 
     import bluefog_tpu as bf
-    bf.init()
-    x = bf.worker_values(lambda rank: ...)   # stacked [size, ...] array
-    y = bf.neighbor_allreduce(x)
+    bf.init()                                 # mesh + default Exp graph
+    x = bf.worker_values(lambda rank: ...)    # stacked [size, ...] array
+    y = bf.neighbor_allreduce(x)              # weighted gossip step
+    h = bf.neighbor_allreduce_nonblocking(x)  # async-dispatch handle
+    y = bf.synchronize(h)
+
+See :mod:`bluefog_tpu.context` for the documented API departures from the
+reference's per-process model.
 """
+
+import jax as _jax
 
 from bluefog_tpu.version import __version__
 from bluefog_tpu import topology
 from bluefog_tpu import topology as topology_util  # reference-style alias
+from bluefog_tpu import collective
+from bluefog_tpu.context import (
+    BluefogContext,
+    get_context,
+    init,
+    is_initialized,
+    shutdown,
+)
+from bluefog_tpu.collective.ops import (
+    worker_values,
+    allreduce,
+    allreduce_nonblocking,
+    allgather,
+    allgather_nonblocking,
+    broadcast,
+    broadcast_nonblocking,
+    neighbor_allreduce,
+    neighbor_allreduce_nonblocking,
+    neighbor_allgather,
+    neighbor_allgather_nonblocking,
+    hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_nonblocking,
+    pair_gossip,
+    pair_gossip_nonblocking,
+    poll,
+    synchronize,
+    wait,
+    barrier,
+)
+
+
+# -- size / rank queries (reference basics.py:112-201) -----------------------
+
+
+def size() -> int:
+    """Number of workers (= mesh devices; the reference's MPI world size)."""
+    return get_context().size
+
+
+def local_size() -> int:
+    """Workers per machine (reference local communicator size)."""
+    return get_context().local_size
+
+
+def machine_size() -> int:
+    """Number of machines in the hierarchical split."""
+    return get_context().machine_size
+
+
+def rank() -> int:
+    """Controller process index. 0 under single-controller; equals the
+    reference's rank only in the shared one-process-per-host regime. Worker
+    identity lives in the mesh axis, not the process — see
+    :mod:`bluefog_tpu.context`."""
+    return _jax.process_index()
+
+
+def local_rank() -> int:
+    """Process-local analogue of :func:`rank` (0 on a single controller)."""
+    return 0
+
+
+def machine_rank(worker_rank: int) -> int:
+    """Machine index of a worker rank (reference basics.py:180-188)."""
+    return worker_rank // get_context().local_size
+
+
+def is_homogeneous() -> bool:
+    """All machines have the same worker count — always true here because
+    the machines×local split is a mesh reshape (reference basics.py:190-201
+    discovers this over MPI)."""
+    return True
+
+
+# -- topology management -----------------------------------------------------
+
+
+def set_topology(topology_graph=None, is_weighted: bool = False) -> bool:
+    """Install a new virtual topology (reference basics.py:311-419). With
+    ``None`` restores the default ExponentialGraph."""
+    ctx = get_context()
+    if topology_graph is None:
+        topology_graph = topology.ExponentialGraph(ctx.size)
+    return ctx.set_topology(topology_graph, is_weighted)
+
+
+def load_topology():
+    """The active topology digraph (reference basics.py:292-309)."""
+    return get_context().load_topology()
+
+
+def is_topo_weighted() -> bool:
+    return get_context().is_topo_weighted()
+
+
+def set_machine_topology(topology_graph, is_weighted: bool = False) -> bool:
+    """Install the machine-level topology for hierarchical ops
+    (reference basics.py:267-309)."""
+    return get_context().set_machine_topology(topology_graph, is_weighted)
+
+
+def load_machine_topology():
+    return get_context().load_machine_topology()
+
+
+def is_machine_topo_weighted() -> bool:
+    return get_context().is_machine_topo_weighted()
+
+
+def in_neighbor_ranks(rank: int = None):
+    """In-neighbors of ``rank``; all ranks' lists when ``rank`` is None
+    (single-controller lift of reference basics.py:203-233)."""
+    return get_context().in_neighbor_ranks(rank)
+
+
+def out_neighbor_ranks(rank: int = None):
+    return get_context().out_neighbor_ranks(rank)
+
+
+def in_neighbor_machine_ranks(machine_rank: int = None):
+    return get_context().in_neighbor_machine_ranks(machine_rank)
+
+
+def out_neighbor_machine_ranks(machine_rank: int = None):
+    return get_context().out_neighbor_machine_ranks(machine_rank)
+
+
+__all__ = [
+    "__version__",
+    "topology",
+    "topology_util",
+    "collective",
+    "BluefogContext",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "get_context",
+    "size",
+    "local_size",
+    "machine_size",
+    "rank",
+    "local_rank",
+    "machine_rank",
+    "is_homogeneous",
+    "set_topology",
+    "load_topology",
+    "is_topo_weighted",
+    "set_machine_topology",
+    "load_machine_topology",
+    "is_machine_topo_weighted",
+    "in_neighbor_ranks",
+    "out_neighbor_ranks",
+    "in_neighbor_machine_ranks",
+    "out_neighbor_machine_ranks",
+    "worker_values",
+    "allreduce",
+    "allreduce_nonblocking",
+    "allgather",
+    "allgather_nonblocking",
+    "broadcast",
+    "broadcast_nonblocking",
+    "neighbor_allreduce",
+    "neighbor_allreduce_nonblocking",
+    "neighbor_allgather",
+    "neighbor_allgather_nonblocking",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_nonblocking",
+    "pair_gossip",
+    "pair_gossip_nonblocking",
+    "poll",
+    "synchronize",
+    "wait",
+    "barrier",
+]

@@ -13,10 +13,8 @@ Every function takes a per-worker array (the shard_map block) plus an
 """
 
 import functools
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
-import numpy as np
-import jax
 import jax.numpy as jnp
 from jax import lax
 

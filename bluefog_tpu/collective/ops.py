@@ -1,0 +1,538 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Eager facade: BlueFog-style collectives over stacked "worker arrays".
+
+Under single-controller SPMD a distributed value is one global array whose
+leading axis is the worker axis: ``x[r]`` is worker ``r``'s value. The
+functions here mirror the reference torch op wrappers
+(``torch/mpi_ops.py``) — blocking call, ``*_nonblocking`` + handle, weight
+policy, topology check — but dispatch one compiled ``shard_map`` program
+instead of enqueueing to a background MPI thread. JAX's async dispatch *is*
+the nonblocking model: every op returns immediately with a future-backed
+array, and ``synchronize`` blocks on readiness (replacing the reference
+HandleManager, ``torch/handle_manager.h:30-41``).
+
+Weight-policy parity (reference ``mpi_ops.py:479-530``), lifted to
+single-controller form: per-rank weight specs are sequences/dicts indexed
+by rank (the controller sees every rank), not the reference's implicit
+"my rank" arguments. A flat ``{rank: float}`` dict raises with guidance.
+"""
+
+import itertools
+import numbers
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import context as ctx_mod
+from bluefog_tpu.collective import inner
+from bluefog_tpu.collective.plan import (
+    CommPlan,
+    plan_from_topology,
+    plan_from_weights,
+)
+
+__all__ = [
+    "worker_values",
+    "allreduce",
+    "allreduce_nonblocking",
+    "allgather",
+    "allgather_nonblocking",
+    "broadcast",
+    "broadcast_nonblocking",
+    "neighbor_allreduce",
+    "neighbor_allreduce_nonblocking",
+    "neighbor_allgather",
+    "neighbor_allgather_nonblocking",
+    "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_nonblocking",
+    "pair_gossip",
+    "pair_gossip_nonblocking",
+    "poll",
+    "synchronize",
+    "wait",
+    "barrier",
+]
+
+# -- handle model ------------------------------------------------------------
+
+_handle_map: Dict[int, Tuple] = {}
+_handle_counter = itertools.count()
+
+
+def _new_handle(result, post=None) -> int:
+    """Register dispatched output; ``post`` (host-side) runs at synchronize
+    so nonblocking+synchronize returns exactly what the blocking op does."""
+    handle = next(_handle_counter)
+    _handle_map[handle] = (result, post)
+    return handle
+
+
+def poll(handle: int) -> bool:
+    """True when the op behind ``handle`` has finished executing
+    (reference ``mpi_ops.py:901-914``)."""
+    result, _ = _handle_map[handle]
+    leaves = jax.tree_util.tree_leaves(result)
+    return all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready"))
+
+
+def synchronize(handle: int):
+    """Block until done and return the output (reference mpi_ops.py:916-933)."""
+    result, post = _handle_map.pop(handle)
+    result = jax.block_until_ready(result)
+    return post(result) if post is not None else result
+
+
+def wait(handle: int):
+    """Alias of :func:`synchronize` — with compiled dispatch there is no
+    separate busy-poll phase (reference mpi_ops.py:936-948)."""
+    return synchronize(handle)
+
+
+def barrier() -> None:
+    """Block the controller until all workers are idle
+    (reference ``MPI_Barrier``; here: dispatch a psum and block on it)."""
+    ctx = ctx_mod.get_context()
+    fn = _compiled(
+        ctx, "barrier", (), lambda: inner.barrier(ctx_mod.WORKER_AXIS).reshape(1),
+        in_specs=(), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+    jax.block_until_ready(fn())
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def worker_values(values, dtype=None) -> jax.Array:
+    """Stack per-worker values into a [size, ...] worker array.
+
+    ``values`` may be a callable ``rank -> array``, a sequence of per-rank
+    arrays, or a single array broadcast to every worker. The result is
+    sharded along the worker mesh axis.
+    """
+    ctx = ctx_mod.get_context()
+    if callable(values):
+        stacked = np.stack([np.asarray(values(r)) for r in range(ctx.size)])
+    elif isinstance(values, (list, tuple)):
+        assert len(values) == ctx.size, (
+            f"expected {ctx.size} per-worker values, got {len(values)}"
+        )
+        stacked = np.stack([np.asarray(v) for v in values])
+    else:
+        arr = np.asarray(values)
+        stacked = np.broadcast_to(arr, (ctx.size,) + arr.shape)
+    if dtype is not None:
+        stacked = stacked.astype(dtype)
+    sharding = NamedSharding(ctx.mesh, P(ctx_mod.WORKER_AXIS))
+    return jax.device_put(stacked, sharding)
+
+
+def _check_worker_array(ctx, x) -> jax.Array:
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != ctx.size:
+        raise ValueError(
+            f"expected a worker array with leading axis {ctx.size} "
+            f"(one slot per worker), got shape {tuple(x.shape)}"
+        )
+    return x
+
+
+def _aval_key(*arrays) -> Tuple:
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
+def _compiled(ctx, name, key, fn, in_specs, out_specs, mesh=None):
+    cache_key = (name,) + tuple(key)
+    cached = ctx.op_cache.get(cache_key)
+    if cached is None:
+        cached = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh or ctx.mesh, in_specs=in_specs, out_specs=out_specs
+            )
+        )
+        ctx.op_cache[cache_key] = cached
+    return cached
+
+
+def _reject_flat_weight_dict(arg_name, value):
+    if isinstance(value, dict) and value and all(
+        isinstance(v, numbers.Number) for v in value.values()
+    ):
+        raise ValueError(
+            f"{arg_name} looks like a single rank's flat {{rank: weight}} "
+            "dict. Under single-controller SPMD pass per-rank specs: a "
+            "sequence (or {rank: ...} dict) of one entry per rank, e.g. "
+            f"{arg_name}=[{{...}} for each rank]. See bluefog_tpu.context "
+            "module docstring for the API-departure rationale."
+        )
+
+
+def _static_plan(ctx) -> CommPlan:
+    topo = ctx.load_topology()
+    assert topo is not None, "no topology set; call bf.init()/bf.set_topology()"
+    key = ("static_plan", ctx.topo_version, ctx.is_topo_weighted())
+    plan = ctx.op_cache.get(key)
+    if plan is None:
+        plan = plan_from_topology(topo, weighted=ctx.is_topo_weighted())
+        ctx.op_cache[key] = plan
+    return plan
+
+
+def _resolve_plan(
+    ctx,
+    self_weight,
+    src_weights,
+    dst_weights,
+    enable_topo_check: bool,
+) -> CommPlan:
+    """The reference weight policy (mpi_ops.py:479-530) on the controller.
+
+    - nothing given: static topology, topology weights if ``is_weighted``
+      else uniform 1/(in_degree+1);
+    - self+src given: explicit combine weights; src keys must be
+      in-neighbors of the static topology unless dst_weights (dynamic mode)
+      is also given;
+    - dst given: dynamic mode; self+src required; send/recv symmetry
+      checked unless disabled.
+    """
+    if (self_weight is None) != (src_weights is None):
+        raise ValueError(
+            "Arguments self_weight and src_weights have to be presented at "
+            "the same time."
+        )
+    _reject_flat_weight_dict("src_weights", src_weights)
+
+    if self_weight is None and src_weights is None:
+        if dst_weights is not None:
+            raise ValueError(
+                "Arguments self_weight and src_weights should be presented "
+                "if enabling dynamic topology (dst_weights)."
+            )
+        return _static_plan(ctx)
+
+    dynamic = dst_weights is not None
+    if not dynamic:
+        # src keys must be in-neighbors (reference mpi_ops.py:513-517).
+        in_sets = [set(lst) for lst in ctx.in_neighbor_ranks()]
+        per_rank = (
+            [src_weights.get(r, {}) for r in range(ctx.size)]
+            if isinstance(src_weights, dict)
+            else list(src_weights)
+        )
+        for r, entry in enumerate(per_rank):
+            keys = set(entry.keys() if isinstance(entry, dict) else entry)
+            if not keys.issubset(in_sets[r]):
+                raise ValueError(
+                    f"src_weights for rank {r} contains {sorted(keys - in_sets[r])} "
+                    "which are not in-neighbors of the current topology."
+                )
+    return plan_from_weights(
+        ctx.size,
+        self_weight,
+        src_weights,
+        dst_weights,
+        enable_topo_check=enable_topo_check and dst_weights is not None,
+    )
+
+
+# -- classic collectives -----------------------------------------------------
+
+
+def allreduce_nonblocking(x, average: bool = True, name: Optional[str] = None) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    fn = _compiled(
+        ctx, "allreduce", (average,) + _aval_key(x),
+        lambda xb: inner.allreduce(xb, ctx_mod.WORKER_AXIS, average=average),
+        in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+    return _new_handle(fn(x))
+
+
+def allreduce(x, average: bool = True, name: Optional[str] = None):
+    """Global (ring-)allreduce over all workers: [size, ...] -> [size, ...]
+    with every row equal to the mean (or sum). Reference mpi_ops.py:79-135."""
+    return synchronize(allreduce_nonblocking(x, average, name))
+
+
+def allgather_nonblocking(x, name: Optional[str] = None) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    fn = _compiled(
+        ctx, "allgather", _aval_key(x),
+        lambda xb: inner.allgather(xb, ctx_mod.WORKER_AXIS),
+        in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+
+    def post(out):
+        # out is [size*size, d0, ...]: size blocks of each worker's
+        # [size, d0, ...] copy. Merge the copy's leading two axes into the
+        # reference's concatenated [size * d0, ...] layout, keeping the
+        # worker axis first.
+        return out.reshape((ctx.size, -1) + tuple(out.shape[2:]))
+
+    return _new_handle(fn(x), post)
+
+
+def allgather(x, name: Optional[str] = None):
+    """Concatenation of all workers' slots, per worker.
+
+    Worker array ``[size, d0, ...]`` -> ``[size, size * d0, ...]``: row ``r``
+    is worker ``r``'s copy of the full concatenation (reference returns
+    ``[size * d0, ...]`` per process, mpi_ops.py:139-188).
+    """
+    return synchronize(allgather_nonblocking(x, name))
+
+
+def broadcast_nonblocking(x, root_rank: int, name: Optional[str] = None) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    assert 0 <= root_rank < ctx.size
+    fn = _compiled(
+        ctx, "broadcast", (root_rank,) + _aval_key(x),
+        lambda xb: inner.broadcast(xb, root_rank, ctx_mod.WORKER_AXIS),
+        in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+    return _new_handle(fn(x))
+
+
+def broadcast(x, root_rank: int, name: Optional[str] = None):
+    """Every worker's slot becomes the root's value.
+    Reference mpi_ops.py:192-260."""
+    return synchronize(broadcast_nonblocking(x, root_rank, name))
+
+
+# -- neighbor collectives ----------------------------------------------------
+
+
+def neighbor_allreduce_nonblocking(
+    x,
+    *,
+    self_weight: Union[float, Sequence[float], None] = None,
+    src_weights=None,
+    dst_weights=None,
+    enable_topo_check: bool = True,
+    name: Optional[str] = None,
+) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    plan = _resolve_plan(ctx, self_weight, src_weights, dst_weights, enable_topo_check)
+    fn = _compiled(
+        ctx, "neighbor_allreduce", (plan,) + _aval_key(x),
+        lambda xb: inner.neighbor_allreduce(xb, plan, ctx_mod.WORKER_AXIS),
+        in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+    return _new_handle(fn(x))
+
+
+def neighbor_allreduce(
+    x,
+    *,
+    self_weight=None,
+    src_weights=None,
+    dst_weights=None,
+    enable_topo_check: bool = True,
+    name: Optional[str] = None,
+):
+    """Weighted averaging with in-neighbors per the active (or explicit)
+    topology. Reference ``mpi_ops.py:534-586``; combine math
+    ``mpi_ops.cc:99-164``; exchange ``mpi_controller.cc:419-551``."""
+    return synchronize(
+        neighbor_allreduce_nonblocking(
+            x,
+            self_weight=self_weight,
+            src_weights=src_weights,
+            dst_weights=dst_weights,
+            enable_topo_check=enable_topo_check,
+            name=name,
+        )
+    )
+
+
+def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    plan = _static_plan(ctx)
+    fn = _compiled(
+        ctx, "neighbor_allgather", (plan,) + _aval_key(x),
+        lambda xb: inner.neighbor_allgather(xb, plan, ctx_mod.WORKER_AXIS),
+        in_specs=P(ctx_mod.WORKER_AXIS),
+        out_specs=(P(ctx_mod.WORKER_AXIS), P(ctx_mod.WORKER_AXIS)),
+    )
+    size, max_deg = ctx.size, plan.max_in_degree
+    in_neighbors = plan.in_neighbors
+
+    def post(result):
+        vals, _mask = result
+        # vals is [size * max_deg, 1, *value_shape] (shard_map block axis
+        # kept); split the worker axis and drop the unit block axis.
+        vals = np.asarray(vals).reshape(
+            (size, max_deg) + tuple(vals.shape[1:])
+        )[:, :, 0]
+        return [
+            jnp.asarray(vals[r, : len(in_neighbors[r])]) for r in range(size)
+        ]
+
+    return _new_handle(fn(x), post)
+
+
+def neighbor_allgather(x, name: Optional[str] = None) -> List[jax.Array]:
+    """Collect raw in-neighbor values, rank-ascending.
+
+    Returns a per-rank list: entry ``r`` has shape ``[in_degree_r, ...]``
+    (the reference concatenates along dim 0, mpi_ops.py:264-323; we keep
+    the neighbor axis explicit — ``.reshape(-1, *rest)`` recovers the
+    reference layout).
+    """
+    return synchronize(neighbor_allgather_nonblocking(x, name))
+
+
+def hierarchical_neighbor_allreduce_nonblocking(
+    x,
+    *,
+    self_weight: Optional[float] = None,
+    neighbor_machine_weights=None,
+    send_neighbor_machines=None,
+    enable_topo_check: bool = True,
+    name: Optional[str] = None,
+) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    mtopo = ctx.load_machine_topology()
+
+    if self_weight is None and neighbor_machine_weights is None:
+        assert mtopo is not None, (
+            "no machine topology set; call bf.set_machine_topology() or pass "
+            "explicit machine weights"
+        )
+        key = (
+            "machine_plan",
+            ctx.machine_topo_version,
+            ctx.is_machine_topo_weighted(),
+        )
+        mplan = ctx.op_cache.get(key)
+        if mplan is None:
+            mplan = plan_from_topology(
+                mtopo, weighted=ctx.is_machine_topo_weighted()
+            )
+            ctx.op_cache[key] = mplan
+    else:
+        assert self_weight is not None and neighbor_machine_weights is not None, (
+            "self_weight and neighbor_machine_weights must be presented "
+            "together (reference mpi_ops.py:648-821)"
+        )
+        _reject_flat_weight_dict(
+            "neighbor_machine_weights", neighbor_machine_weights
+        )
+        mplan = plan_from_weights(
+            ctx.machine_size,
+            self_weight,
+            neighbor_machine_weights,
+            send_neighbor_machines,
+            enable_topo_check=enable_topo_check
+            and send_neighbor_machines is not None,
+        )
+
+    fn = _compiled(
+        ctx, "hier_neighbor_allreduce", (mplan,) + _aval_key(x),
+        lambda xb: inner.hierarchical_neighbor_allreduce(
+            xb, mplan, ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS
+        ),
+        in_specs=P((ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS)),
+        out_specs=P((ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS)),
+        mesh=ctx.machine_mesh,
+    )
+    return _new_handle(fn(x))
+
+
+def hierarchical_neighbor_allreduce(
+    x,
+    *,
+    self_weight=None,
+    neighbor_machine_weights=None,
+    send_neighbor_machines=None,
+    enable_topo_check: bool = True,
+    name: Optional[str] = None,
+):
+    """Machine-level gossip: intra-machine average then machine-graph
+    combine. Reference mpi_ops.py:648-821 / mpi_controller.cc:507-541."""
+    return synchronize(
+        hierarchical_neighbor_allreduce_nonblocking(
+            x,
+            self_weight=self_weight,
+            neighbor_machine_weights=neighbor_machine_weights,
+            send_neighbor_machines=send_neighbor_machines,
+            enable_topo_check=enable_topo_check,
+            name=name,
+        )
+    )
+
+
+def _resolve_pairs(ctx, target_ranks) -> Tuple[Tuple[int, int], ...]:
+    """Accept either disjoint ``pairs=[(a, b), ...]`` or the reference's
+    per-rank ``target_ranks`` list (must be an involution)."""
+    target_ranks = list(target_ranks)
+    if target_ranks and isinstance(target_ranks[0], (tuple, list)):
+        pairs = tuple((int(a), int(b)) for a, b in target_ranks)
+        seen = set()
+        for a, b in pairs:
+            if not (0 <= a < ctx.size and 0 <= b < ctx.size):
+                raise ValueError(
+                    f"pair_gossip pair ({a}, {b}) out of range for "
+                    f"{ctx.size} workers"
+                )
+            if a == b:
+                raise ValueError(f"pair_gossip partner must differ (rank {a})")
+            if a in seen or b in seen:
+                raise ValueError(
+                    f"pair_gossip: rank in more than one pair: ({a}, {b})"
+                )
+            seen.update((a, b))
+        return pairs
+    assert len(target_ranks) == ctx.size, (
+        "per-rank target_ranks must list one partner per rank (use -1 for "
+        "ranks that sit out)"
+    )
+    pairs = []
+    for a, b in enumerate(target_ranks):
+        if b is None or b < 0:
+            continue
+        if b >= ctx.size:
+            raise ValueError(
+                f"pair_gossip target {b} out of range for {ctx.size} workers"
+            )
+        if b == a:
+            raise ValueError(f"pair_gossip partner must differ (rank {a})")
+        if target_ranks[b] != a:
+            raise ValueError(
+                f"pair_gossip targets must be mutual: rank {a} -> {b} but "
+                f"rank {b} -> {target_ranks[b]}"
+            )
+        if a < b:
+            pairs.append((a, b))
+    return tuple(pairs)
+
+
+def pair_gossip_nonblocking(
+    x, target_ranks, self_weight=None, extra_weight=None, name=None
+) -> int:
+    ctx = ctx_mod.get_context()
+    x = _check_worker_array(ctx, x)
+    pairs = _resolve_pairs(ctx, target_ranks)
+    fn = _compiled(
+        ctx, "pair_gossip", (pairs, self_weight, extra_weight) + _aval_key(x),
+        lambda xb: inner.pair_gossip(
+            xb, pairs, ctx_mod.WORKER_AXIS, self_weight, extra_weight
+        ),
+        in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
+    )
+    return _new_handle(fn(x))
+
+
+def pair_gossip(x, target_ranks, self_weight=None, extra_weight=None, name=None):
+    """Average with exactly one partner (reference mpi_ops.py:838-899)."""
+    return synchronize(
+        pair_gossip_nonblocking(x, target_ranks, self_weight, extra_weight, name)
+    )

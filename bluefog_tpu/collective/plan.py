@@ -32,6 +32,7 @@ by ``lax.switch`` on the step index — no recompilation when peers change.
 """
 
 import dataclasses
+import functools
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -84,7 +85,7 @@ class CommPlan:
     self_weights: Tuple[float, ...]
     rounds: Tuple[CommRound, ...]
 
-    @property
+    @functools.cached_property
     def in_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
         """Sorted in-neighbor list per rank (ascending, reference order —
         reference tests check neighbor_allgather output is rank-ordered)."""
@@ -94,7 +95,7 @@ class CommPlan:
                 ins[d].append(s)
         return tuple(tuple(sorted(lst)) for lst in ins)
 
-    @property
+    @functools.cached_property
     def out_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
         outs: List[List[int]] = [[] for _ in range(self.size)]
         for rnd in self.rounds:

@@ -1,0 +1,255 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Process-global runtime context: mesh ownership and topology state.
+
+TPU-native replacement for the reference's ``BlueFogBasics`` object plus the
+C-side global state (reference ``common/basics.py:37-568``,
+``common/global_state.h``). There is no background thread, no coordinator
+and no ctypes boundary: the single controller owns a ``jax.sharding.Mesh``
+over the worker devices, and every collective is a compiled SPMD program
+over that mesh.
+
+Deliberate API departures from the per-process reference model (documented
+here once; individual functions cite back):
+
+- A "worker" is a mesh device, not an OS process. ``size()`` is the number
+  of worker devices.
+- Per-rank queries (``in_neighbor_ranks`` etc.) take an explicit ``rank``
+  argument; with ``rank=None`` they return every rank's answer, because the
+  single controller sees all ranks at once. The reference's implicit "my
+  rank" does not exist under SPMD.
+- ``rank()`` / ``local_rank()`` report the *controller process* position
+  (``jax.process_index``), which matches the reference only in the one
+  launch regime both share (one process per host, multi-host DCN).
+"""
+
+import os
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import networkx as nx
+
+import jax
+from jax.sharding import Mesh
+
+from bluefog_tpu.topology import ExponentialGraph, serpentine_device_order
+from bluefog_tpu.topology.graphs import IsTopologyEquivalent
+
+__all__ = ["BluefogContext", "get_context", "init", "shutdown", "is_initialized"]
+
+WORKER_AXIS = "workers"
+MACHINE_AXIS = "machines"
+LOCAL_AXIS = "local"
+
+_lock = threading.Lock()
+_context: Optional["BluefogContext"] = None
+
+
+class BluefogContext:
+    """Owns the device mesh, the active topology, and compiled-op caches."""
+
+    def __init__(
+        self,
+        topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
+        is_weighted: bool = False,
+        devices: Optional[Sequence] = None,
+        nodes_per_machine: Optional[int] = None,
+    ):
+        if devices is None:
+            devices = jax.devices()
+            if jax.process_count() > 1:
+                # The machines x local split below chunks the ordered device
+                # list, so the order must be host-contiguous or the "local"
+                # psum would span hosts over DCN. Serpentine within each
+                # host keeps intra-host hops short; hosts are ordered by
+                # process index (DCN neighbors in typical pod wiring).
+                by_proc: dict = {}
+                for d in devices:
+                    by_proc.setdefault(d.process_index, []).append(d)
+                devices = [
+                    d
+                    for proc in sorted(by_proc)
+                    for d in serpentine_device_order(by_proc[proc])
+                ]
+            else:
+                devices = serpentine_device_order(devices)
+        self.devices: List = list(devices)
+        self.size: int = len(self.devices)
+
+        # 1-D gossip mesh over all workers.
+        self.mesh = Mesh(np.array(self.devices), (WORKER_AXIS,))
+
+        # Optional machines × local submesh split for hierarchical ops.
+        # Mirrors BLUEFOG_NODES_PER_MACHINE faking of multi-node on one host
+        # (reference common/mpi_context.cc:320-337); on a real multi-host
+        # pod the natural split is jax.local_device_count() per process.
+        if nodes_per_machine is None:
+            env = os.environ.get("BLUEFOG_NODES_PER_MACHINE")
+            if env:
+                nodes_per_machine = int(env)
+            elif jax.process_count() > 1:
+                nodes_per_machine = len(
+                    [d for d in self.devices if d.process_index == 0]
+                )
+        self.local_size: int = nodes_per_machine or self.size
+        assert self.size % self.local_size == 0, (
+            f"nodes_per_machine={self.local_size} must divide the worker "
+            f"count {self.size}"
+        )
+        self.machine_size: int = self.size // self.local_size
+        self.machine_mesh = Mesh(
+            np.array(self.devices).reshape(self.machine_size, self.local_size),
+            (MACHINE_AXIS, LOCAL_AXIS),
+        )
+
+        self._topology: Optional[nx.DiGraph] = None
+        self._topo_weighted: bool = False
+        self._machine_topology: Optional[nx.DiGraph] = None
+        self._machine_topo_weighted: bool = False
+        # Monotonic versions for cache keys: id(graph) is unsafe (CPython
+        # reuses addresses after GC), so compiled-plan caches key on these.
+        self.topo_version: int = 0
+        self.machine_topo_version: int = 0
+
+        # Compiled-function cache: key -> jitted callable. Keys include the
+        # (hashable) plan/schedule and input avals, so topology changes that
+        # reuse an already-seen plan hit the cache instead of recompiling.
+        self.op_cache: dict = {}
+
+        if topology_fn is not None:
+            topo = topology_fn(self.size)
+            assert topo is not None, "topology_fn returned None"
+            self.set_topology(topo, is_weighted)
+        else:
+            # Reference default: ExponentialGraph, unweighted combine
+            # (common/basics.py:65-69).
+            self.set_topology(ExponentialGraph(self.size), is_weighted)
+
+    # -- topology management (reference basics.py:311-419) ------------------
+
+    def set_topology(self, topology: nx.DiGraph, is_weighted: bool = False) -> bool:
+        if not isinstance(topology, nx.DiGraph):
+            raise TypeError("topology must be a networkx.DiGraph")
+        if topology.number_of_nodes() != self.size:
+            raise ValueError(
+                f"topology has {topology.number_of_nodes()} nodes but the "
+                f"mesh has {self.size} workers"
+            )
+        if IsTopologyEquivalent(topology, self._topology) and (
+            is_weighted == self._topo_weighted
+        ):
+            return True  # no-op, parity with basics.py:340-345
+        self._topology = topology
+        self._topo_weighted = is_weighted
+        self.topo_version += 1
+        return True
+
+    def load_topology(self) -> nx.DiGraph:
+        return self._topology
+
+    def is_topo_weighted(self) -> bool:
+        return self._topo_weighted
+
+    def set_machine_topology(self, topology: nx.DiGraph, is_weighted: bool = False) -> bool:
+        if not isinstance(topology, nx.DiGraph):
+            raise TypeError("machine topology must be a networkx.DiGraph")
+        if topology.number_of_nodes() != self.machine_size:
+            raise ValueError(
+                f"machine topology has {topology.number_of_nodes()} nodes "
+                f"but there are {self.machine_size} machines"
+            )
+        self._machine_topology = topology
+        self._machine_topo_weighted = is_weighted
+        self.machine_topo_version += 1
+        return True
+
+    def load_machine_topology(self) -> nx.DiGraph:
+        return self._machine_topology
+
+    def is_machine_topo_weighted(self) -> bool:
+        return self._machine_topo_weighted
+
+    # -- neighbor queries (reference basics.py:203-265) ----------------------
+
+    def in_neighbor_ranks(self, rank: Optional[int] = None):
+        assert self._topology is not None
+        if rank is None:
+            return [self.in_neighbor_ranks(r) for r in range(self.size)]
+        return sorted(r for r in self._topology.predecessors(rank) if r != rank)
+
+    def out_neighbor_ranks(self, rank: Optional[int] = None):
+        assert self._topology is not None
+        if rank is None:
+            return [self.out_neighbor_ranks(r) for r in range(self.size)]
+        return sorted(r for r in self._topology.successors(rank) if r != rank)
+
+    def in_neighbor_machine_ranks(self, machine_rank: Optional[int] = None):
+        if self._machine_topology is None:
+            return None
+        if machine_rank is None:
+            return [
+                self.in_neighbor_machine_ranks(m) for m in range(self.machine_size)
+            ]
+        return sorted(
+            m
+            for m in self._machine_topology.predecessors(machine_rank)
+            if m != machine_rank
+        )
+
+    def out_neighbor_machine_ranks(self, machine_rank: Optional[int] = None):
+        if self._machine_topology is None:
+            return None
+        if machine_rank is None:
+            return [
+                self.out_neighbor_machine_ranks(m) for m in range(self.machine_size)
+            ]
+        return sorted(
+            m
+            for m in self._machine_topology.successors(machine_rank)
+            if m != machine_rank
+        )
+
+
+def init(
+    topology_fn: Optional[Callable[[int], nx.DiGraph]] = None,
+    is_weighted: bool = False,
+    devices: Optional[Sequence] = None,
+    nodes_per_machine: Optional[int] = None,
+) -> BluefogContext:
+    """Initialize the global context (reference ``bf.init``, basics.py:49-70).
+
+    ``topology_fn`` receives the worker count and returns the initial
+    topology (default ``ExponentialGraph``). ``devices`` overrides the mesh
+    device list (default: all devices in serpentine torus order);
+    ``nodes_per_machine`` configures the machines×local split for
+    hierarchical ops (default from BLUEFOG_NODES_PER_MACHINE or the
+    per-process device count on multi-host).
+    """
+    global _context
+    with _lock:
+        _context = BluefogContext(
+            topology_fn=topology_fn,
+            is_weighted=is_weighted,
+            devices=devices,
+            nodes_per_machine=nodes_per_machine,
+        )
+    return _context
+
+
+def shutdown() -> None:
+    """Drop the global context (reference ``bf.shutdown``)."""
+    global _context
+    with _lock:
+        _context = None
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def get_context() -> BluefogContext:
+    if _context is None:
+        raise RuntimeError(
+            "bluefog_tpu is not initialized; call bluefog_tpu.init() first."
+        )
+    return _context

@@ -1,0 +1,13 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Benchmark / example model zoo.
+
+The reference treats models as externals (torchvision ResNet50 in
+``examples/pytorch_benchmark.py``, a small conv/MLP net in
+``examples/pytorch_mnist.py``); the TPU rebuild ships its own flax
+implementations so the BASELINE configs are reproducible without torch.
+"""
+
+from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from bluefog_tpu.models.mlp import MLP, MnistCNN
+
+__all__ = ["ResNet", "ResNet18", "ResNet50", "MLP", "MnistCNN"]

@@ -1,0 +1,45 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Small models for optimizer tests and the MNIST example.
+
+Counterpart of the reference test/example nets (``examples/pytorch_mnist.py``
+Net: two convs + two dense; ``test/torch_optimizer_test.py`` uses small
+MLPs to assert loss decrease per optimizer family).
+"""
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["MLP", "MnistCNN"]
+
+
+class MLP(nn.Module):
+    """Plain MLP used by optimizer convergence tests."""
+
+    features: Sequence[int] = (64, 32, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features[:-1]:
+            x = nn.relu(nn.Dense(f)(x))
+        return nn.Dense(self.features[-1])(x)
+
+
+class MnistCNN(nn.Module):
+    """Conv net mirroring the reference MNIST example topology."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,303 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Facade tests: init / topology management / eager ops / handle model.
+
+Parity model: reference ``test/torch_basics_test.py`` (init, rank/size,
+topology set/load, neighbor queries) and the eager-op slices of
+``test/torch_ops_test.py`` lifted to worker arrays.
+"""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+import jax.numpy as jnp
+
+import bluefog_tpu as bf
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context():
+    bf.init()
+    yield
+    bf.shutdown()
+
+
+def rand(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_init_sizes():
+    assert bf.is_initialized()
+    assert bf.size() == SIZE
+    assert bf.rank() == 0
+    assert bf.local_rank() == 0
+    assert bf.is_homogeneous()
+    # Single process, no nodes_per_machine: one flat machine.
+    assert bf.local_size() == SIZE
+    assert bf.machine_size() == 1
+
+
+def test_default_topology_is_exponential():
+    topo = bf.load_topology()
+    assert bf.topology.IsTopologyEquivalent(
+        topo, bf.topology.ExponentialGraph(SIZE)
+    )
+    assert not bf.is_topo_weighted()
+
+
+def test_set_load_topology_roundtrip():
+    ring = bf.topology.RingGraph(SIZE)
+    assert bf.set_topology(ring, is_weighted=True)
+    assert bf.topology.IsTopologyEquivalent(bf.load_topology(), ring)
+    assert bf.is_topo_weighted()
+    # Reset to default.
+    assert bf.set_topology(None)
+    assert bf.topology.IsTopologyEquivalent(
+        bf.load_topology(), bf.topology.ExponentialGraph(SIZE)
+    )
+
+
+def test_set_topology_wrong_size_raises():
+    with pytest.raises(ValueError, match="workers"):
+        bf.set_topology(bf.topology.RingGraph(SIZE + 1))
+
+
+def test_neighbor_ranks_queries():
+    bf.set_topology(bf.topology.RingGraph(SIZE))
+    for r in range(SIZE):
+        assert bf.in_neighbor_ranks(r) == sorted({(r - 1) % SIZE, (r + 1) % SIZE})
+        assert bf.out_neighbor_ranks(r) == sorted({(r - 1) % SIZE, (r + 1) % SIZE})
+    all_ins = bf.in_neighbor_ranks()
+    assert len(all_ins) == SIZE and all_ins[0] == [1, SIZE - 1]
+
+
+def test_worker_values_forms():
+    x = bf.worker_values(lambda r: np.full((3,), float(r)))
+    np.testing.assert_allclose(np.asarray(x), np.arange(SIZE)[:, None] * np.ones(3))
+    y = bf.worker_values([np.full((2,), r) for r in range(SIZE)])
+    assert y.shape == (SIZE, 2)
+    z = bf.worker_values(np.ones((4,)))
+    assert z.shape == (SIZE, 4)
+
+
+def test_neighbor_allreduce_default_uniform():
+    """Default (no weights): uniform 1/(in_deg+1) combine over the default
+    unweighted Exp topology (reference mpi_ops.py:500-505)."""
+    x = rand((SIZE, 5), seed=1)
+    got = np.asarray(bf.neighbor_allreduce(bf.worker_values(list(x))))
+    adj = nx.to_numpy_array(bf.load_topology())
+    expected = np.zeros_like(x)
+    for j in range(SIZE):
+        srcs = [i for i in range(SIZE) if adj[i, j] != 0 and i != j]
+        expected[j] = (x[j] + x[srcs].sum(0)) / (len(srcs) + 1)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_neighbor_allreduce_weighted_topology():
+    ring = bf.topology.RingGraph(SIZE)
+    bf.set_topology(ring, is_weighted=True)
+    x = rand((SIZE, 4), seed=2)
+    got = np.asarray(bf.neighbor_allreduce(jnp.asarray(x)))
+    expected = nx.to_numpy_array(ring).T @ x
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_neighbor_allreduce_explicit_weights():
+    bf.set_topology(bf.topology.RingGraph(SIZE))
+    src_w = [
+        {(j - 1) % SIZE: 0.3, (j + 1) % SIZE: 0.2} for j in range(SIZE)
+    ]
+    x = rand((SIZE, 3), seed=3)
+    got = np.asarray(
+        bf.neighbor_allreduce(jnp.asarray(x), self_weight=0.5, src_weights=src_w)
+    )
+    expected = np.zeros_like(x)
+    for j in range(SIZE):
+        expected[j] = (
+            0.5 * x[j] + 0.3 * x[(j - 1) % SIZE] + 0.2 * x[(j + 1) % SIZE]
+        )
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_neighbor_allreduce_rejects_non_neighbors():
+    bf.set_topology(bf.topology.RingGraph(SIZE))
+    src_w = [{(j + 2) % SIZE: 0.5} for j in range(SIZE)]  # not an in-neighbor
+    with pytest.raises(ValueError, match="not in-neighbors"):
+        bf.neighbor_allreduce(
+            jnp.asarray(rand((SIZE, 2))), self_weight=0.5, src_weights=src_w
+        )
+
+
+def test_neighbor_allreduce_rejects_flat_dict():
+    with pytest.raises(ValueError, match="per-rank"):
+        bf.neighbor_allreduce(
+            jnp.asarray(rand((SIZE, 2))),
+            self_weight=0.5,
+            src_weights={1: 0.5},
+        )
+
+
+def test_neighbor_allreduce_dynamic_dst_weights():
+    """Dynamic mode: dst list + explicit self/src weights, stepping a
+    one-peer schedule eagerly (the reference README dynamic-topology loop)."""
+    g = bf.topology.ExponentialTwoGraph(SIZE)
+    bf.set_topology(g)
+    iters = [
+        bf.topology.GetDynamicOnePeerSendRecvRanks(g, r) for r in range(SIZE)
+    ]
+    x = rand((SIZE, 4), seed=4)
+    val = jnp.asarray(x)
+    for _ in range(3):
+        lists = [next(it) for it in iters]
+        dst_w = [send for send, _ in lists]
+        src_w = [{s: 0.5 for s in recv} for _, recv in lists]
+        got = np.asarray(
+            bf.neighbor_allreduce(
+                val, self_weight=0.5, src_weights=src_w, dst_weights=dst_w
+            )
+        )
+        cur = np.asarray(val)
+        expected = np.zeros_like(cur)
+        for j, (_, recv) in enumerate(lists):
+            expected[j] = 0.5 * cur[j] + sum(0.5 * cur[s] for s in recv)
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+        val = jnp.asarray(got)
+
+
+def test_dynamic_requires_self_and_src():
+    with pytest.raises(ValueError, match="dynamic topology"):
+        bf.neighbor_allreduce(
+            jnp.asarray(rand((SIZE, 2))), dst_weights=[[1]] * SIZE
+        )
+
+
+def test_allreduce_allgather_broadcast():
+    x = rand((SIZE, 3), seed=5)
+    avg = np.asarray(bf.allreduce(jnp.asarray(x)))
+    np.testing.assert_allclose(avg, np.tile(x.mean(0), (SIZE, 1)), rtol=1e-5)
+
+    summed = np.asarray(bf.allreduce(jnp.asarray(x), average=False))
+    np.testing.assert_allclose(summed, np.tile(x.sum(0), (SIZE, 1)), rtol=1e-5)
+
+    # Per-worker value is [3]; reference concatenates along dim 0 -> [24].
+    gathered = np.asarray(bf.allgather(jnp.asarray(x)))
+    assert gathered.shape == (SIZE, SIZE * 3)
+    np.testing.assert_allclose(gathered[5].reshape(SIZE, 3), x, rtol=1e-6)
+
+    bc = np.asarray(bf.broadcast(jnp.asarray(x), root_rank=4))
+    np.testing.assert_allclose(bc, np.tile(x[4], (SIZE, 1)), rtol=1e-6)
+
+
+def test_neighbor_allgather():
+    bf.set_topology(bf.topology.StarGraph(SIZE))
+    x = rand((SIZE, 2), seed=6)
+    per_rank = bf.neighbor_allgather(jnp.asarray(x))
+    assert len(per_rank) == SIZE
+    # Center (0) receives everyone else, rank-ascending.
+    np.testing.assert_allclose(np.asarray(per_rank[0]), x[1:], rtol=1e-6)
+    # Leaves receive only the center.
+    for r in range(1, SIZE):
+        np.testing.assert_allclose(np.asarray(per_rank[r]), x[:1], rtol=1e-6)
+
+
+def test_pair_gossip_facade():
+    x = rand((SIZE, 2), seed=7)
+    got = np.asarray(bf.pair_gossip(jnp.asarray(x), [(0, 1), (2, 3)]))
+    np.testing.assert_allclose(got[0], 0.5 * (x[0] + x[1]), rtol=1e-6)
+    np.testing.assert_allclose(got[7], x[7], rtol=1e-6)
+    # Per-rank involution form.
+    targets = [1, 0, 3, 2, -1, -1, -1, -1]
+    got2 = np.asarray(bf.pair_gossip(jnp.asarray(x), targets))
+    np.testing.assert_allclose(got2, got, rtol=1e-6)
+    with pytest.raises(ValueError, match="mutual"):
+        bf.pair_gossip(jnp.asarray(x), [1, 2, 0, -1, -1, -1, -1, -1])
+
+
+def test_handle_model():
+    x = rand((SIZE, 3), seed=8)
+    h = bf.allreduce_nonblocking(jnp.asarray(x))
+    assert isinstance(h, int)
+    out = bf.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.tile(x.mean(0), (SIZE, 1)), rtol=1e-5)
+    h2 = bf.neighbor_allreduce_nonblocking(jnp.asarray(x))
+    _ = bf.poll(h2)  # may be True or False; must not raise
+    out2 = bf.wait(h2)
+    assert out2.shape == (SIZE, 3)
+    bf.barrier()
+
+
+def test_hierarchical_facade():
+    bf.init(nodes_per_machine=4)
+    assert bf.local_size() == 4 and bf.machine_size() == 2
+    assert bf.machine_rank(5) == 1
+    ring = bf.topology.RingGraph(2)
+    bf.set_machine_topology(ring, is_weighted=True)
+    assert bf.in_neighbor_machine_ranks(0) == [1]
+
+    x = rand((SIZE, 3), seed=9)
+    got = np.asarray(bf.hierarchical_neighbor_allreduce(jnp.asarray(x)))
+    wm = nx.to_numpy_array(ring)
+    means = x.reshape(2, 4, 3).mean(1)
+    expected = np.repeat(wm.T @ means, 4, axis=0)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_hierarchical_explicit_weights():
+    bf.init(nodes_per_machine=2)  # 4 machines
+    mw = [{(m - 1) % 4: 0.5} for m in range(4)]
+    x = rand((SIZE, 2), seed=10)
+    got = np.asarray(
+        bf.hierarchical_neighbor_allreduce(
+            jnp.asarray(x),
+            self_weight=0.5,
+            neighbor_machine_weights=mw,
+            send_neighbor_machines=[[(m + 1) % 4] for m in range(4)],
+        )
+    )
+    means = x.reshape(4, 2, 2).mean(1)
+    expected_m = np.zeros_like(means)
+    for m in range(4):
+        expected_m[m] = 0.5 * means[m] + 0.5 * means[(m - 1) % 4]
+    np.testing.assert_allclose(
+        got, np.repeat(expected_m, 2, axis=0), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_plan_cache_follows_topology_changes():
+    """Switching topologies must not serve a stale compiled plan."""
+    x = rand((SIZE, 3), seed=11)
+    bf.set_topology(bf.topology.RingGraph(SIZE), is_weighted=True)
+    ring_out = np.asarray(bf.neighbor_allreduce(jnp.asarray(x)))
+    bf.set_topology(bf.topology.StarGraph(SIZE), is_weighted=True)
+    star_out = np.asarray(bf.neighbor_allreduce(jnp.asarray(x)))
+    np.testing.assert_allclose(
+        ring_out, nx.to_numpy_array(bf.topology.RingGraph(SIZE)).T @ x, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        star_out, nx.to_numpy_array(bf.topology.StarGraph(SIZE)).T @ x, rtol=1e-5
+    )
+
+
+def test_nonblocking_matches_blocking_layout():
+    """synchronize(nonblocking) returns exactly the blocking op's layout."""
+    bf.set_topology(bf.topology.StarGraph(SIZE))
+    x = rand((SIZE, 2), seed=12)
+    blocking = bf.neighbor_allgather(jnp.asarray(x))
+    nonblocking = bf.synchronize(bf.neighbor_allgather_nonblocking(jnp.asarray(x)))
+    assert len(blocking) == len(nonblocking)
+    for a, b in zip(blocking, nonblocking):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    ag_block = np.asarray(bf.allgather(jnp.asarray(x)))
+    ag_nonblock = np.asarray(bf.synchronize(bf.allgather_nonblocking(jnp.asarray(x))))
+    assert ag_block.shape == ag_nonblock.shape
+    np.testing.assert_allclose(ag_block, ag_nonblock, rtol=1e-6)
+
+
+def test_uninitialized_raises():
+    bf.shutdown()
+    with pytest.raises(RuntimeError, match="not initialized"):
+        bf.size()
+    bf.init()  # restore for the autouse fixture's shutdown

@@ -1,0 +1,18 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Driver-contract smoke tests (the instruments the harness runs)."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_4():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(4)
