@@ -72,12 +72,17 @@ def GetExp2DynamicSendRecvMachineRanks(
     m + 2^(t mod K) and receives from m - 2^(t mod K).
     Parity: reference topology_util.py:360-396.
     """
-    assert (self_rank % local_size) == local_rank, \
-        "It should be used under homogeneous environment only."
-    assert (world_size % local_size) == 0, \
-        "It should be used under homogeneous environment only."
-    assert world_size > local_size, \
-        "It should be used under at least two machines case."
+    assert (self_rank % local_size) == local_rank, (
+        "machine schedule requires a homogeneous layout: self_rank % "
+        "local_size must equal local_rank"
+    )
+    assert (world_size % local_size) == 0, (
+        "machine schedule requires a homogeneous layout: local_size must "
+        "divide world_size"
+    )
+    assert world_size > local_size, (
+        "machine schedule needs at least two machines (world_size > local_size)"
+    )
 
     machine_id = self_rank // local_size
     machine_size = world_size // local_size
@@ -101,12 +106,15 @@ def GetInnerOuterRingDynamicSendRecvRanks(
     """
     num_machines = world_size // local_size
     nodes_per_machine = local_size
-    assert world_size % local_size == 0, \
-        "It should be used under homogeneous environment only."
+    assert world_size % local_size == 0, (
+        "inner/outer ring schedule requires a homogeneous layout: local_size "
+        "must divide world_size"
+    )
     assert local_size > 2, (
-        "Do no support the case where nodes_per_machine is equal or less "
-        "than 2. Consider use hierarchical_neighbor_allreduce or "
-        "GetDynamicOnePeerSendRecvRanks."
+        "inner/outer ring schedule needs more than 2 workers per machine "
+        "(the inner ring is empty otherwise); use "
+        "hierarchical_neighbor_allreduce or GetDynamicOnePeerSendRecvRanks "
+        "for small machines"
     )
 
     machine_id = self_rank // nodes_per_machine
@@ -144,12 +152,15 @@ def GetInnerOuterExpo2DynamicSendRecvRanks(
     """
     num_machines = world_size // local_size
     nodes_per_machine = local_size
-    assert world_size % local_size == 0, \
-        "It should be used under homogeneous environment only."
+    assert world_size % local_size == 0, (
+        "inner/outer Exp2 schedule requires a homogeneous layout: local_size "
+        "must divide world_size"
+    )
     assert local_size > 2, (
-        "Do no support the case where nodes_per_machine is equal or less "
-        "than 2. Consider use hierarchical_neighbor_allreduce or "
-        "GetDynamicOnePeerSendRecvRanks."
+        "inner/outer Exp2 schedule needs more than 2 workers per machine "
+        "(the inner ring is empty otherwise); use "
+        "hierarchical_neighbor_allreduce or GetDynamicOnePeerSendRecvRanks "
+        "for small machines"
     )
 
     exp_2_out_size = int(np.log2(num_machines - 1))

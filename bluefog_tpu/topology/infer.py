@@ -69,16 +69,18 @@ def InferSourceFromDestinationRanks(
 ) -> Any:
     """Who sends to me, given who everyone sends to.
 
+    Deliberate API departure from the reference (which takes one process's
+    flat list and allgathers the rest, torch/topology_util.py:22-60): under
+    single-controller SPMD the caller must pass *every* rank's list; a flat
+    list raises with guidance.
+
     Args:
         dst_ranks: per-rank destination lists ``[[dst...] for each rank]``.
-            For reference-signature compatibility a single flat list is also
-            accepted together with ``rank``/``size`` (taken from the active
-            bluefog context when omitted), in which case the remaining ranks'
-            lists are assumed symmetric is NOT possible — a flat list without
-            the full picture raises.
         construct_adjacency_matrix: also return the column-normalized W.
         rank: if given, return only this rank's inferred list (reference
             behavior); otherwise return the list for every rank.
+        size: optional expected world size; validated against
+            ``len(dst_ranks)`` when given.
     """
     per_rank = _normalize(dst_ranks, rank, size)
     n = len(per_rank)
@@ -111,7 +113,15 @@ def InferDestinationFromSourceRanks(
 
 def _normalize(ranks, rank, size) -> List[List[int]]:
     if len(ranks) and isinstance(ranks[0], (list, tuple, np.ndarray)):
-        return [list(map(int, lst)) for lst in ranks]
+        per_rank = [list(map(int, lst)) for lst in ranks]
+        if size is not None and size != len(per_rank):
+            raise ValueError(
+                f"size={size} does not match the {len(per_rank)} per-rank "
+                "lists given"
+            )
+        if rank is not None and not (0 <= rank < len(per_rank)):
+            raise ValueError(f"rank={rank} out of range for {len(per_rank)} ranks")
+        return per_rank
     raise ValueError(
         "Expected per-rank lists [[...] for each rank]; a single rank's flat "
         "list cannot determine the global topology under single-controller "

@@ -175,7 +175,8 @@ def test_inner_outer_ring_is_permutation(world, local):
         all_dsts = [sends[r][0] for r in range(world)]
         assert sorted(all_dsts) == list(range(world))  # a permutation
         for r in range(world):
-            assert recvs[r] == [all_dsts.index(r)] or all_dsts[recvs[r][0]] == r
+            assert len(recvs[r]) == 1
+            assert all_dsts[recvs[r][0]] == r  # my declared source sends to me
 
 
 @pytest.mark.parametrize("world,local", [(16, 4), (32, 8)])
@@ -195,6 +196,7 @@ def test_inner_outer_expo2_is_permutation(world, local):
 
 def test_exp2_machine_schedule():
     world, local = 16, 4
+    machines = world // local
     gens = {
         r: tu.GetExp2DynamicSendRecvMachineRanks(world, local, r, r % local)
         for r in range(world)
@@ -203,6 +205,21 @@ def test_exp2_machine_schedule():
     assert s == [1] and rv == [3]  # machine 0 -> 1, recv from 3 (4 machines)
     s, rv = next(gens[0])
     assert s == [2] and rv == [2]
+    # Full period, all ranks: the machine-level pattern must be a consistent
+    # permutation — every machine sends to machine+2^t and receives from
+    # machine-2^t, and ranks on the same machine agree.
+    gens = {
+        r: tu.GetExp2DynamicSendRecvMachineRanks(world, local, r, r % local)
+        for r in range(world)
+    }
+    period = int(np.log2(machines - 1)) + 1
+    for t in range(2 * period):
+        dist = 2 ** (t % period)
+        for r in range(world):
+            s, rv = next(gens[r])
+            m = r // local
+            assert s == [(m + dist) % machines]
+            assert rv == [(m - dist) % machines]
 
 
 # ---------------------------------------------------------------------------
@@ -256,3 +273,78 @@ def test_serpentine_order_torus():
     # serpentine: consecutive coords differ by one hop
     for a, b in zip(coords, coords[1:]):
         assert sum(abs(i - j) for i, j in zip(a, b)) == 1
+
+
+class _Dev:
+    def __init__(self, coords):
+        self.coords = coords
+
+    def __repr__(self):
+        return f"D{self.coords}"
+
+
+def _grid_devs(dims):
+    """Fake devices covering a full (x, y[, z]) grid of the given dims."""
+    import itertools
+
+    return [
+        _Dev(c[::-1])
+        for c in itertools.product(*(range(n) for n in reversed(dims)))
+    ]
+
+
+def _torus_hops(a, b, dims):
+    """ICI hop count between coords on a torus with wrap links."""
+    return sum(min(abs(i - j), n - abs(i - j)) for i, j, n in zip(a, b, dims))
+
+
+@pytest.mark.parametrize(
+    "dims", [(4, 2), (4, 8), (4, 2, 2), (2, 2, 4), (4, 4, 4)]
+)
+def test_boustrophedon_single_hop(dims):
+    """Every consecutive pair in the walk is ONE physical hop — including the
+    3-D z-plane seam the round-1 implementation got wrong (ADVICE r1)."""
+    devs = _grid_devs(dims)
+    ordered = tu.serpentine_device_order(devs)
+    assert len(ordered) == len(devs)
+    assert {d.coords for d in ordered} == {d.coords for d in devs}
+    coords = [d.coords for d in ordered]
+    for a, b in zip(coords, coords[1:]):
+        assert _torus_hops(a, b, dims) == 1, (a, b)
+    # closing ring edge rides torus wrap links (even dims): short, not O(N)
+    assert _torus_hops(coords[-1], coords[0], dims) <= 2
+
+
+@pytest.mark.parametrize("dims", [(4, 8), (8, 8), (4, 4, 4)])
+def test_exp2_placement_hop_counts(dims):
+    """Hop-count evidence for the placement claims (measured, not asserted
+    from prose): under the boustrophedon order every ring step is exactly one
+    ICI hop (row-major has 2-3-hop seams), and across the Exp-2 offsets the
+    boustrophedon's *worst* per-offset average never exceeds row-major's,
+    while its total stays within 5% (row-major's power-of-two offsets map to
+    pure-axis moves on a wrap-linked torus, so it wins the total slightly)."""
+    devs = _grid_devs(dims)
+    n = len(devs)
+    naive = [d.coords for d in devs]  # row-major, x fastest
+    ordered = [d.coords for d in tu.serpentine_device_order(devs)]
+    offsets = [2**k for k in range(int(np.log2(n - 1)) + 1)]
+
+    def per_offset_avg(order):
+        return {
+            off: sum(
+                _torus_hops(order[r], order[(r + off) % n], dims)
+                for r in range(n)
+            )
+            / n
+            for off in offsets
+        }
+
+    h_ord, h_naive = per_offset_avg(ordered), per_offset_avg(naive)
+    # wrap edge excluded: it is covered (<= 2 hops) by the single-hop test
+    assert all(
+        _torus_hops(ordered[r], ordered[r + 1], dims) == 1
+        for r in range(n - 1)
+    )
+    assert max(_torus_hops(naive[r], naive[r + 1], dims) for r in range(n - 1)) > 1
+    assert max(h_ord.values()) <= max(h_naive.values())
+    assert sum(h_ord.values()) <= 1.05 * sum(h_naive.values())
