@@ -35,6 +35,27 @@ from bluefog_tpu.context import (
     is_initialized,
     shutdown,
 )
+from bluefog_tpu.windows import (
+    win_create,
+    win_free,
+    win_update,
+    win_update_then_collect,
+    win_put,
+    win_put_nonblocking,
+    win_get,
+    win_get_nonblocking,
+    win_accumulate,
+    win_accumulate_nonblocking,
+    win_wait,
+    win_poll,
+    win_mutex,
+    win_read,
+    get_win_version,
+    get_current_created_window_names,
+    turn_on_win_ops_with_associated_p,
+    turn_off_win_ops_with_associated_p,
+    win_associated_p,
+)
 from bluefog_tpu.collective.ops import (
     worker_values,
     allreduce,
@@ -200,4 +221,23 @@ __all__ = [
     "synchronize",
     "wait",
     "barrier",
+    "win_create",
+    "win_free",
+    "win_update",
+    "win_update_then_collect",
+    "win_put",
+    "win_put_nonblocking",
+    "win_get",
+    "win_get_nonblocking",
+    "win_accumulate",
+    "win_accumulate_nonblocking",
+    "win_wait",
+    "win_poll",
+    "win_mutex",
+    "win_read",
+    "get_win_version",
+    "get_current_created_window_names",
+    "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
+    "win_associated_p",
 ]

@@ -1,0 +1,332 @@
+"""Window-op subsystem tests.
+
+Mirrors the semantics coverage of reference test/torch_win_ops_test.py on
+the 8-device virtual CPU mesh: lifecycle, update with default/given
+weights, update_then_collect, put/get/accumulate (full and partial
+destinations), version counters, mutex no-op, and the associated-p lane.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as tu
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    bf.win_free()
+    bf.shutdown()
+
+
+def ranks_tensor(shape=(5,)):
+    return bf.worker_values(lambda r: np.full(shape, float(r), np.float32))
+
+
+def exp2_in_neighbors(rank, size=SIZE):
+    indegree = int(np.ceil(np.log2(size)))
+    return [(rank - 2**i) % size for i in range(indegree)]
+
+
+def test_win_create_update_free():
+    x = ranks_tensor()
+    assert bf.win_create(x, "w")
+    assert not bf.win_create(x, "w")  # duplicate name
+    out = np.asarray(bf.win_update("w"))
+    # buffers hold copies of my own value -> update is the identity
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], r, atol=1e-5)
+    assert bf.get_current_created_window_names() == ["w"]
+    assert bf.win_free("w")
+    assert not bf.win_free("w")
+    assert bf.get_current_created_window_names() == []
+
+
+def test_win_free_all():
+    x = ranks_tensor()
+    bf.win_create(x, "a")
+    bf.win_create(x, "b")
+    assert bf.get_current_created_window_names() == ["a", "b"]
+    assert bf.win_free()
+    assert bf.get_current_created_window_names() == []
+
+
+def test_win_update_with_given_weights():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    ins = bf.in_neighbor_ranks()
+    weights = [
+        {s: 1.0 / (len(ins[r]) + 1) for s in ins[r]} for r in range(SIZE)
+    ]
+    self_w = [1.0 / (len(ins[r]) + 1) for r in range(SIZE)]
+    out = np.asarray(bf.win_update("w", self_weight=self_w, neighbor_weights=weights))
+    for r in range(SIZE):
+        np.testing.assert_allclose(out[r], r, atol=1e-5)
+
+
+def test_win_update_then_collect_twice():
+    """Collect sums self + buffers then zeroes buffers, so the second
+    collect returns the same value (reference torch_win_ops_test.py:214)."""
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    indegree = int(np.ceil(np.log2(SIZE)))
+    # First collect: self (rank) + indegree buffers holding create-time
+    # copies (rank each). Second: value is rank*(indeg+1), buffers zeroed.
+    for _ in range(2):
+        out = np.asarray(bf.win_update_then_collect("w"))
+        for r in range(SIZE):
+            np.testing.assert_allclose(out[r], r * (indegree + 1), atol=1e-4)
+
+
+def test_win_put_default():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    out = np.asarray(bf.win_update("w"))
+    for r in range(SIZE):
+        ns = exp2_in_neighbors(r)
+        expect = (r + sum(ns)) / (len(ns) + 1)
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_put_given_destination():
+    """Each rank puts 1.23x its value to rank+1 only; other buffers keep the
+    create-time copy (reference torch_win_ops_test.py:385-424)."""
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    dst = [{(r + 1) % SIZE: 1.23} for r in range(SIZE)]
+    bf.win_put(x, "w", dst_weights=dst)
+    out = np.asarray(bf.win_update("w"))
+    for r in range(SIZE):
+        ns = exp2_in_neighbors(r)
+        indeg = len(ns)
+        expect = (r * indeg + 1.23 * ((r - 1) % SIZE)) / (indeg + 1)
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_accumulate_default():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.win_accumulate(x, "w")
+    out = np.asarray(bf.win_update("w"))
+    for r in range(SIZE):
+        ns = exp2_in_neighbors(r)
+        outdeg = len(ns)
+        expect = r + sum(ns) / (outdeg + 1)
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_accumulate_given_destination():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    dst = [{(r + 1) % SIZE: 1.23} for r in range(SIZE)]
+    bf.win_accumulate(x, "w", dst_weights=dst)
+    nw = [{(r - 1) % SIZE: 0.5} for r in range(SIZE)]
+    out = np.asarray(
+        bf.win_update("w", self_weight=0.5, neighbor_weights=nw)
+    )
+    for r in range(SIZE):
+        expect = 0.5 * r + 0.5 * (r + 1.23 * ((r - 1) % SIZE))
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_get_default():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.win_get("w")
+    out = np.asarray(bf.win_update("w"))
+    for r in range(SIZE):
+        ns = exp2_in_neighbors(r)
+        expect = (r + sum(ns)) / (len(ns) + 1)
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_get_given_sources():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    src = [{(r - 1) % SIZE: 2.0} for r in range(SIZE)]
+    bf.win_get("w", src_weights=src)
+    out = np.asarray(bf.win_update("w"))
+    for r in range(SIZE):
+        ns = exp2_in_neighbors(r)
+        indeg = len(ns)
+        # the (r-1) buffer now holds 2*(r-1); the rest keep the copy of r
+        expect = (r + 2.0 * ((r - 1) % SIZE) + (indeg - 1) * r) / (indeg + 1)
+        np.testing.assert_allclose(out[r], expect, atol=1e-4)
+
+
+def test_win_version_counters():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    before = bf.get_win_version("w")
+    for r in range(SIZE):
+        assert set(before[r]) == set(exp2_in_neighbors(r))
+        assert all(v == 0 for v in before[r].values())
+    bf.win_put(x, "w")
+    after = bf.get_win_version("w")
+    for r in range(SIZE):
+        assert all(v == 1 for v in after[r].values())
+    bf.win_put(x, "w")
+    assert all(v == 2 for v in bf.get_win_version("w", rank=0).values())
+    bf.win_update("w")
+    cleared = bf.get_win_version("w")
+    for r in range(SIZE):
+        assert all(v == 0 for v in cleared[r].values())
+
+
+def test_win_partial_write_versions():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    dst = [{(r + 1) % SIZE: 1.0} for r in range(SIZE)]
+    bf.win_put(x, "w", dst_weights=dst)
+    vers = bf.get_win_version("w")
+    for r in range(SIZE):
+        for s, v in vers[r].items():
+            assert v == (1 if s == (r - 1) % SIZE else 0)
+
+
+def test_win_put_to_non_neighbor_raises():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    # rank 0 -> rank 3 is not an Exp2(8) edge (offsets are 1, 2, 4)
+    dst = [None] * SIZE
+    dst[0] = {3: 1.0}
+    with pytest.raises(ValueError, match="not an in-neighbor"):
+        bf.win_put(x, "w", dst_weights=dst)
+
+
+def test_win_update_invalid_source_raises():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    nw = [{s: 0.5 for s in exp2_in_neighbors(r)} for r in range(SIZE)]
+    nw[0] = {3: 1.0}  # 3 is not an Exp2(8) in-neighbor of 0
+    with pytest.raises(ValueError, match="no buffer slot"):
+        bf.win_update("w", self_weight=0.5, neighbor_weights=nw)
+    # changing topology without re-creating the window must also raise
+    bf.set_topology(tu.MeshGrid2DGraph(SIZE), is_weighted=True)
+    with pytest.raises(ValueError, match="no buffer slot"):
+        bf.win_update("w")
+
+
+def test_win_update_participation():
+    """A rank whose neighbor_weights entry is None sits the update out:
+    value, p, and buffers stay untouched."""
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    nw = [
+        None if r == 0 else {s: 0.0 for s in exp2_in_neighbors(r)}
+        for r in range(SIZE)
+    ]
+    out = np.asarray(bf.win_update("w", self_weight=0.5, neighbor_weights=nw))
+    np.testing.assert_allclose(out[0], 0.0, atol=1e-6)  # value was rank 0 = 0
+    # rank 0 kept its value scale: re-check with a nonzero rank sitting out
+    bf.win_free("w")
+    bf.win_create(x, "w")
+    nw[0], nw[3] = {s: 0.0 for s in exp2_in_neighbors(0)}, None
+    out = np.asarray(bf.win_update("w", self_weight=0.5, neighbor_weights=nw))
+    np.testing.assert_allclose(out[3], 3.0, atol=1e-6)  # untouched
+    np.testing.assert_allclose(out[1], 0.5, atol=1e-6)  # halved
+
+
+def test_associated_p_off_stays_one():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.win_accumulate(x, "w", self_weight=0.5)
+    bf.win_update_then_collect("w")
+    np.testing.assert_allclose(bf.win_associated_p("w"), 1.0)
+
+
+def test_win_mutex_noop():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    with bf.win_mutex("w"):
+        bf.win_put(x, "w")
+    with pytest.raises(ValueError):
+        with bf.win_mutex("nope"):
+            pass
+
+
+def test_associated_p_ring_accumulate():
+    """Parity with reference torch_win_ops_test.py:823-862: one sender
+    accumulates with self_weight=0.5 split over its two ring neighbors."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        for send_rank in range(SIZE):
+            name = f"p_{send_rank}"
+            x = ranks_tensor(shape=(1,))
+            bf.win_create(x, name)
+            left, right = (send_rank - 1) % SIZE, (send_rank + 1) % SIZE
+            dst = [None] * SIZE
+            dst[send_rank] = {left: 0.5, right: 0.5}
+            bf.win_accumulate(x, name, self_weight=0.5, dst_weights=dst)
+            bf.win_update_then_collect(name)
+            p = bf.win_associated_p(name)
+            for r in range(SIZE):
+                if r == send_rank:
+                    assert p[r] == pytest.approx(0.5)
+                elif r in (left, right):
+                    assert p[r] == pytest.approx(1.5)
+                else:
+                    assert p[r] == pytest.approx(1.0)
+            bf.win_free(name)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+
+
+def test_associated_p_tracks_value():
+    """The p lane undergoes the same linear ops as the window value: with a
+    1-filled tensor and zero_init, p equals the value after any op mix
+    (reference torch_win_ops_test.py:864-904)."""
+    rng = np.random.RandomState(7)
+    x = bf.worker_values(np.ones((3,), np.float32))
+    bf.win_create(x, "w", zero_init=True)
+    bf.turn_on_win_ops_with_associated_p()
+    outs = bf.out_neighbor_ranks()
+    for _ in range(5):
+        dst, sw = [], []
+        for r in range(SIZE):
+            w = rng.rand(len(outs[r]) + 1)
+            w /= w.sum()
+            sw.append(float(w[-1]))
+            dst.append({d: float(w[i]) for i, d in enumerate(outs[r])})
+        bf.win_put(None, "w", self_weight=sw, dst_weights=dst)
+        bf.win_update("w")
+        bf.win_accumulate(None, "w", self_weight=sw, dst_weights=dst)
+        bf.win_update_then_collect("w")
+    val = np.asarray(bf.win_update_then_collect("w"))
+    p = bf.win_associated_p("w")
+    bf.turn_off_win_ops_with_associated_p()
+    np.testing.assert_allclose(p, val[:, 0], atol=1e-5)
+
+
+def test_push_sum_consensus():
+    """Push-sum over a directed ring converges to the true average: the
+    algorithmic contract the window subsystem exists for (reference
+    optimizers.py:1026-1177 semantics distilled)."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))  # directed ring
+    bf.turn_on_win_ops_with_associated_p()
+    vals = np.arange(SIZE, dtype=np.float32)
+    x = bf.worker_values(lambda r: np.array([vals[r]], np.float32))
+    bf.win_create(x, "ps", zero_init=True)
+    outs = bf.out_neighbor_ranks()
+    for _ in range(150):  # directed-ring mixing rate is cos(pi/8) ~ 0.92
+        dst = [
+            {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]} for r in range(SIZE)
+        ]
+        sw = [1.0 / (len(outs[r]) + 1) for r in range(SIZE)]
+        bf.win_accumulate(None, "ps", self_weight=sw, dst_weights=dst)
+        out = bf.win_update_then_collect("ps")
+        out.block_until_ready()
+    p = bf.win_associated_p("ps")
+    bf.turn_off_win_ops_with_associated_p()
+    # pure accumulate sequences conserve push-sum mass
+    assert float(np.sum(p)) == pytest.approx(SIZE, abs=1e-3)
+    corrected = np.asarray(out)[:, 0] / p
+    np.testing.assert_allclose(corrected, vals.mean(), atol=1e-3)
