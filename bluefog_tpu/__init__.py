@@ -56,6 +56,23 @@ from bluefog_tpu.windows import (
     turn_off_win_ops_with_associated_p,
     win_associated_p,
 )
+from bluefog_tpu.optimizers import (
+    CommunicationType,
+    DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedAdaptThenCombineOptimizer,
+    DistributedAdaptWithCombineOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)
+from bluefog_tpu.utility import (
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    allreduce_parameters,
+)
 from bluefog_tpu.collective.ops import (
     worker_values,
     allreduce,
@@ -240,4 +257,17 @@ __all__ = [
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
     "win_associated_p",
+    "CommunicationType",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedAdaptThenCombineOptimizer",
+    "DistributedAdaptWithCombineOptimizer",
+    "DistributedWinPutOptimizer",
+    "DistributedPullGetOptimizer",
+    "DistributedPushSumOptimizer",
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "allreduce_parameters",
 ]

@@ -1,0 +1,44 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Initial-state synchronization helpers over parameter pytrees.
+
+Reference ``torch/utility.py:26-216``: ``broadcast_parameters`` pushes
+rank-0 (or any root's) values to every worker before training,
+``broadcast_optimizer_state`` does the same for optimizer state (there it
+needs scalar->tensor wrapping and callback tricks; optax states are plain
+pytrees, so the same tree broadcast covers it), and
+``allreduce_parameters`` averages in place.
+
+All helpers take worker-stacked pytrees (leading axis = worker) and return
+new pytrees.
+"""
+
+import jax
+
+from bluefog_tpu.collective import ops as col_ops
+
+__all__ = [
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "allreduce_parameters",
+]
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Every worker's slot becomes the root worker's value
+    (reference torch/utility.py:26-56)."""
+    return jax.tree_util.tree_map(
+        lambda t: col_ops.broadcast(t, root_rank), params
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Tree broadcast of optimizer state (reference torch/utility.py:89-216;
+    the scalar-wrapping machinery there is unnecessary for optax pytrees)."""
+    return jax.tree_util.tree_map(
+        lambda t: col_ops.broadcast(t, root_rank), opt_state
+    )
+
+
+def allreduce_parameters(params):
+    """Average every leaf across workers (reference torch/utility.py:58-87)."""
+    return jax.tree_util.tree_map(lambda t: col_ops.allreduce(t), params)

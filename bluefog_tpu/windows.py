@@ -248,30 +248,29 @@ def _self_weight_vec(ctx, self_weight, participating) -> np.ndarray:
         vec = np.ones((size,))
     elif isinstance(self_weight, (int, float)):
         vec = np.full((size,), float(self_weight))
+    elif isinstance(self_weight, dict):
+        vec = np.asarray(
+            [float(self_weight.get(r, 1.0)) for r in range(size)]
+        )
     else:
         vec = np.asarray([float(v) for v in self_weight])
-        assert vec.shape == (size,), "per-rank self_weight must cover every rank"
+        if vec.shape != (size,):
+            raise ValueError(
+                f"per-rank self_weight must have one entry per rank "
+                f"({size}), got {vec.shape}"
+            )
     return np.where(participating, vec, 1.0)
 
 
 def _edge_rounds(w: np.ndarray):
     """Group directed edges (nonzeros of w) by ring offset into ppermute
-    rounds; returns (perm, recv_weight_vector) per round (same decomposition
-    as plan_from_matrix, over edge weights w[src, dst])."""
-    size = w.shape[0]
-    by_offset: Dict[int, List[Tuple[int, int]]] = {}
-    for i, j in zip(*np.nonzero(w)):
-        if i == j:
-            continue
-        by_offset.setdefault((j - i) % size, []).append((int(i), int(j)))
-    rounds = []
-    for off in sorted(by_offset):
-        perm = tuple(sorted(by_offset[off]))
-        weights = np.zeros((size,))
-        for s, d in perm:
-            weights[d] = w[s, d]
-        rounds.append((perm, weights))
-    return rounds
+    rounds; returns (perm, recv_weight_vector) per round. Reuses the plan
+    lowering (self weights are irrelevant here: the diagonal is zero and
+    window ops apply self scaling separately)."""
+    from bluefog_tpu.collective.plan import plan_from_matrix
+
+    plan = plan_from_matrix(np.asarray(w) * (1 - np.eye(w.shape[0])))
+    return [(r.perm, np.asarray(r.recv_weights)) for r in plan.rounds]
 
 
 def _slot_table(win: _Window, rounds) -> np.ndarray:
@@ -499,6 +498,8 @@ def _update_weights(ctx, win, self_weight, neighbor_weights):
         w_recv, participating = _per_rank_edges(
             ctx, neighbor_weights, win.in_neighbors, "neighbor_weights"
         )
+        # An all-zero-weight entry still participates (it consumes/clears
+        # its buffers); a None entry sits out entirely.
         self_vec = _self_weight_vec(ctx, self_weight, participating)
     else:
         participating = np.ones(size, bool)
@@ -526,17 +527,18 @@ def _update_weights(ctx, win, self_weight, neighbor_weights):
                 f"(create-time in-neighbors: {win.in_neighbors[r]}); "
                 "re-create the window after changing the topology"
             )
-    return self_vec, w_recv
+    return self_vec, w_recv, participating
 
 
-def _update_fn(ctx, win, self_vec, w_recv, reset, update_p):
+def _update_fn(ctx, win, self_vec, w_recv, reset, update_p, participating):
     slot_w = np.zeros((ctx.size, max(win.max_deg, 1)))
     for r, srcs in enumerate(win.in_neighbors):
         for k, s in enumerate(srcs):
             slot_w[r, k] = w_recv[r, s]
     key = (
         "win_update", tuple(self_vec), tuple(map(tuple, slot_w)), bool(reset),
-        update_p, win.shape, str(win.dtype),
+        update_p, tuple(bool(b) for b in participating),
+        win.shape, str(win.dtype),
     )
     cached = ctx.op_cache.get(key)
     if cached is not None:
@@ -544,11 +546,13 @@ def _update_fn(ctx, win, self_vec, w_recv, reset, update_p):
     axis = ctx_mod.WORKER_AXIS
     self_const = np.asarray(self_vec)
     slot_const = np.asarray(slot_w)
+    part_const = np.asarray(participating, bool)
 
     def body(value, buffers, versions, p, p_buffers):
         v, bufs, vers = value[0], buffers[0], versions[0]
         pv, pbufs = p[0], p_buffers[0]
         idx = lax.axis_index(axis)
+        part = jnp.asarray(part_const)[idx]
         sw = jnp.asarray(self_const, v.dtype)[idx]
         kw = jnp.asarray(slot_const, v.dtype)[idx]       # [max_deg]
         new_v = v * sw
@@ -560,11 +564,18 @@ def _update_fn(ctx, win, self_vec, w_recv, reset, update_p):
                 new_p = new_p + jnp.dot(
                     jnp.asarray(slot_const, pv.dtype)[idx], pbufs
                 )
-            new_pbufs = jnp.zeros_like(pbufs) if reset else pbufs
+            new_p = jnp.where(part, new_p, pv)
+            new_pbufs = (
+                jnp.where(part, jnp.zeros_like(pbufs), pbufs)
+                if reset else pbufs
+            )
         else:
             new_p, new_pbufs = pv, pbufs
-        new_bufs = jnp.zeros_like(bufs) if reset else bufs
-        new_vers = jnp.zeros_like(vers)
+        # A sitting-out rank keeps its buffers and pending version counts.
+        new_bufs = (
+            jnp.where(part, jnp.zeros_like(bufs), bufs) if reset else bufs
+        )
+        new_vers = jnp.where(part, jnp.zeros_like(vers), vers)
         expand = lambda t: jnp.expand_dims(t, 0)
         return (
             expand(new_v), expand(new_bufs), expand(new_vers),
@@ -598,8 +609,12 @@ def win_update(
     is always a fresh array)."""
     ctx = ctx_mod.get_context()
     win = _get_win(ctx, name)
-    self_vec, w_recv = _update_weights(ctx, win, self_weight, neighbor_weights)
-    fn = _update_fn(ctx, win, self_vec, w_recv, reset, _associated_p_enabled)
+    self_vec, w_recv, participating = _update_weights(
+        ctx, win, self_weight, neighbor_weights
+    )
+    fn = _update_fn(
+        ctx, win, self_vec, w_recv, reset, _associated_p_enabled, participating
+    )
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
         win.value, win.buffers, win.versions, win.p, win.p_buffers
     )

@@ -1,0 +1,227 @@
+"""Optimizer-layer tests.
+
+Mirrors reference test/torch_optimizer_test.py: each factory trains a small
+problem and must drive the (global) loss down / reach consensus near the
+global optimum. The objective is the decentralized quadratic
+``f_r(x) = 0.5 ||x - c_r||^2`` whose global minimizer is ``mean(c)`` —
+exact, fast, and sensitive to broken combine weights.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as tu
+from bluefog_tpu.collective.plan import schedule_from_dynamic
+
+SIZE = 8
+DIM = 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    bf.win_free()
+    bf.shutdown()
+
+
+def targets():
+    rng = np.random.RandomState(0)
+    return rng.randn(SIZE, DIM).astype(np.float32)
+
+
+def make_params(c):
+    # start each worker AT its local target => pure-local optimum, no
+    # consensus; only communication can pull them to mean(c)
+    return {"w": bf.worker_values(lambda r: c[r])}
+
+
+def quad_grads(params, c):
+    return {"w": params["w"] - jnp.asarray(c)}
+
+
+def global_loss(params, c):
+    w = np.asarray(params["w"])
+    return float(np.mean(0.5 * np.sum((w - c.mean(0)) ** 2, -1)))
+
+
+def disagreement(params):
+    w = np.asarray(params["w"])
+    return float(np.max(np.abs(w - w.mean(0))))
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        bf.DistributedAllreduceOptimizer,
+        bf.DistributedNeighborAllreduceOptimizer,
+        lambda tx: bf.DistributedAdaptThenCombineOptimizer(
+            tx, bf.CommunicationType.neighbor_allreduce
+        ),
+        lambda tx: bf.DistributedAdaptWithCombineOptimizer(
+            tx, bf.CommunicationType.allreduce
+        ),
+    ],
+)
+def test_gossip_families_reach_global_optimum(factory):
+    # decaying lr: constant-step decentralized SGD has O(lr) steady-state
+    # disagreement, so annealing is what yields exact consensus
+    c = targets()
+    opt = factory(optax.sgd(optax.exponential_decay(0.3, 10, 0.5)))
+    params = make_params(c)
+    state = opt.init(params)
+    start = global_loss(params, c)
+    for _ in range(80):
+        grads = quad_grads(params, c)
+        params, state = opt.step(params, state, grads)
+    end = global_loss(params, c)
+    assert end < 0.05 * start
+    assert disagreement(params) < 0.1
+    np.testing.assert_allclose(
+        np.asarray(params["w"]).mean(0), c.mean(0), atol=0.1
+    )
+
+
+def test_gradient_allreduce_matches_centralized():
+    """Gradient averaging must track centralized full-batch SGD exactly."""
+    c = targets()
+    opt = bf.DistributedGradientAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(np.zeros(DIM, np.float32))}
+    state = opt.init(params)
+    x_ref = np.zeros(DIM, np.float32)
+    for _ in range(10):
+        grads = quad_grads(params, c)
+        params, state = opt.step(params, state, grads)
+        x_ref = x_ref - 0.1 * (x_ref - c.mean(0))
+    w = np.asarray(params["w"])
+    for r in range(SIZE):
+        np.testing.assert_allclose(w[r], x_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_empty_communication_is_local_sgd():
+    c = targets()
+    opt = bf.DistributedAdaptWithCombineOptimizer(
+        optax.sgd(0.5), bf.CommunicationType.empty
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    for _ in range(5):
+        params, state = opt.step(params, state, quad_grads(params, c))
+    # no communication: each worker stays at its own target
+    np.testing.assert_allclose(np.asarray(params["w"]), c, atol=1e-5)
+
+
+def test_dynamic_topology_knobs_no_retrace():
+    """Per-step one-peer weights drive the gossip; the compiled-step cache
+    must not grow past the schedule period (no retrace, VERDICT r1 #1)."""
+    c = targets()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.2))
+    params = make_params(c)
+    state = opt.init(params)
+    topo = tu.ExponentialTwoGraph(SIZE)
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(SIZE)]
+    ctx = bf.get_context()
+    cache_sizes = []
+    start = global_loss(params, c)
+    for t in range(12):
+        sr = [next(g) for g in gens]
+        opt.dst_weights = [list(s) for s, _ in sr]
+        opt.src_weights = [{s: 0.5 for s in rv} for _, rv in sr]
+        opt.self_weight = 0.5
+        params, state = opt.step(params, state, quad_grads(params, c))
+        cache_sizes.append(len(ctx.op_cache))
+    # after one full period (log2(8)=3 steps) the cache stops growing
+    assert cache_sizes[-1] == cache_sizes[3]
+    assert global_loss(params, c) < 0.35 * start
+
+
+def test_schedule_plan_single_compile():
+    """A SchedulePlan lowers peer changes to lax.switch: ONE compiled step."""
+    c = targets()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.2))
+    topo = tu.ExponentialTwoGraph(SIZE)
+    opt.schedule = schedule_from_dynamic(
+        SIZE, lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r)
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    ctx = bf.get_context()
+    before = None
+    start = global_loss(params, c)
+    for t in range(9):
+        params, state = opt.step(params, state, quad_grads(params, c))
+        if t == 0:
+            before = len(ctx.op_cache)
+    assert len(ctx.op_cache) == before  # one entry for all steps
+    assert global_loss(params, c) < 0.35 * start
+
+
+def test_hierarchical_optimizer(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=4)
+    bf.set_machine_topology(tu.RingGraph(2))
+    c = targets()
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 10, 0.5))
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    start = global_loss(params, c)
+    for _ in range(60):
+        params, state = opt.step(params, state, quad_grads(params, c))
+    assert global_loss(params, c) < 0.05 * start
+    assert disagreement(params) < 0.1
+
+
+def test_adam_inner_optimizer():
+    """Any optax transformation works as the inner step (the reference
+    hand-implements each inner rule, optimizers.py:564-842)."""
+    c = targets()
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.adam(0.1))
+    params = make_params(c)
+    state = opt.init(params)
+    start = global_loss(params, c)
+    for _ in range(80):
+        params, state = opt.step(params, state, quad_grads(params, c))
+    assert global_loss(params, c) < 0.1 * start
+
+
+@pytest.mark.parametrize(
+    "factory", [bf.DistributedWinPutOptimizer, bf.DistributedPullGetOptimizer]
+)
+def test_window_optimizers(factory):
+    c = targets()
+    opt = factory(optax.sgd(0.2))
+    params = make_params(c)
+    state = opt.init(params)
+    cur = params
+    start = global_loss(cur, c)
+    for _ in range(60):
+        cur, state = opt.step(state, quad_grads(cur, c))
+    assert global_loss(cur, c) < 0.05 * start
+    assert disagreement(cur) < 0.2
+    opt.free()
+
+
+def test_push_sum_optimizer_directed_ring():
+    """Push-sum handles a directed (non-doubly-stochastic) graph where
+    plain gossip would be biased (reference optimizers.py:1026-1177)."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    c = targets()
+    opt = bf.DistributedPushSumOptimizer(
+        optax.sgd(optax.exponential_decay(0.2, 20, 0.5))
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    cur = params
+    start = global_loss(cur, c)
+    for _ in range(150):
+        cur, state = opt.step(state, quad_grads(cur, c))
+    assert global_loss(cur, c) < 0.05 * start
+    assert disagreement(cur) < 0.1
+    opt.free()
+    bf.turn_off_win_ops_with_associated_p()

@@ -1,0 +1,52 @@
+"""Utility-helper tests (reference torch/utility.py semantics)."""
+
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    bf.shutdown()
+
+
+def test_broadcast_parameters():
+    params = {
+        "a": bf.worker_values(lambda r: np.full((3,), float(r), np.float32)),
+        "b": {"c": bf.worker_values(lambda r: np.float32(r * 10))},
+    }
+    out = bf.broadcast_parameters(params, root_rank=2)
+    np.testing.assert_allclose(np.asarray(out["a"]), 2.0)
+    np.testing.assert_allclose(np.asarray(out["b"]["c"]), 20.0)
+
+
+def test_allreduce_parameters():
+    params = {"a": bf.worker_values(lambda r: np.full((2,), float(r)))}
+    out = bf.allreduce_parameters(params)
+    np.testing.assert_allclose(np.asarray(out["a"]), (SIZE - 1) / 2.0)
+
+
+def test_broadcast_optimizer_state():
+    tx = optax.sgd(0.1, momentum=0.9)
+    params = {"w": bf.worker_values(lambda r: np.full((2,), float(r)))}
+    opt = bf.DistributedNeighborAllreduceOptimizer(tx)
+    state = opt.init(params)
+    # poke per-worker momentum, then broadcast rank 0's
+    state_b = bf.broadcast_optimizer_state(state, root_rank=0)
+    for leaf in np.asarray(
+        np.concatenate(
+            [
+                np.asarray(l).reshape(SIZE, -1)
+                for l in __import__("jax").tree_util.tree_leaves(state_b)
+                if hasattr(l, "shape") and l.shape and l.shape[0] == SIZE
+            ],
+            axis=1,
+        )
+    ).T:
+        assert np.allclose(leaf, leaf[0])

@@ -234,6 +234,38 @@ def test_win_update_participation():
     np.testing.assert_allclose(out[1], 0.5, atol=1e-6)  # halved
 
 
+def test_win_update_sitout_keeps_buffers_and_versions():
+    """A None entry keeps that rank's buffers, versions, value, and p."""
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.win_put(x, "w")
+    nw = [
+        None if r == 0 else {s: 0.1 for s in exp2_in_neighbors(r)}
+        for r in range(SIZE)
+    ]
+    bf.win_update("w", self_weight=0.5, neighbor_weights=nw, reset=True)
+    vers = bf.get_win_version("w")
+    assert all(v == 1 for v in vers[0].values())  # rank 0 untouched
+    assert all(v == 0 for v in vers[1].values())  # others cleared
+    # rank 0's pending writes survive to the next full update
+    out = np.asarray(bf.win_update("w"))
+    ns = exp2_in_neighbors(0)
+    np.testing.assert_allclose(out[0], sum(ns) / (len(ns) + 1), atol=1e-4)
+
+
+def test_self_weight_dict_form():
+    x = ranks_tensor()
+    bf.win_create(x, "w")
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        bf.win_accumulate(x, "w", self_weight={r: 0.5 for r in range(SIZE)})
+        np.testing.assert_allclose(bf.win_associated_p("w"), 0.5)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+    with pytest.raises(ValueError, match="one entry per rank"):
+        bf.win_accumulate(x, "w", self_weight=[0.5, 0.5])
+
+
 def test_associated_p_off_stays_one():
     x = ranks_tensor()
     bf.win_create(x, "w")
