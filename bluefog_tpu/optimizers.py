@@ -108,6 +108,10 @@ class _GossipOptimizer:
                 "communication_type must be a CommunicationType, got "
                 f"{communication_type!r}"
             )
+        assert not (
+            order == "grad"
+            and communication_type != CommunicationType.allreduce
+        ), "gradient gossip is only defined for allreduce communication"
         self.tx = base_optimizer
         self.communication_type = communication_type
         self.order = order
@@ -148,6 +152,12 @@ class _GossipOptimizer:
     def _gossip_key_and_fn(self, ctx):
         """Resolve the communication into (cache key piece, block fn)."""
         comm = self.communication_type
+        if self.schedule is not None and comm != CommunicationType.neighbor_allreduce:
+            raise ValueError(
+                "opt.schedule (a SchedulePlan) only applies to "
+                "neighbor_allreduce communication; "
+                f"this optimizer uses {comm.value!r}"
+            )
         if comm == CommunicationType.empty:
             return ("empty",), lambda t, step: t
         if comm == CommunicationType.allreduce:
@@ -213,6 +223,11 @@ class _GossipOptimizer:
             == CommunicationType.hierarchical_neighbor_allreduce
         )
         if hier:
+            if self.schedule is not None:
+                raise ValueError(
+                    "opt.schedule only applies to neighbor_allreduce "
+                    "communication; this optimizer is hierarchical"
+                )
             gossip_key = (self._machine_plan(ctx),)
         else:
             gossip_key, gossip = self._gossip_key_and_fn(ctx)
@@ -244,14 +259,11 @@ class _GossipOptimizer:
                 g = _tree_block(grads_b)
                 step = step[0]
                 if order == "grad":
+                    # order='grad' only exists with allreduce communication
+                    # (DistributedGradientAllreduceOptimizer)
                     g = jax.tree_util.tree_map(
                         lambda t: inner.allreduce(
                             t, ctx_mod.WORKER_AXIS, average=True
-                        )
-                        if not hier
-                        else inner.hierarchical_neighbor_allreduce(
-                            t, gossip_key[0], ctx_mod.MACHINE_AXIS,
-                            ctx_mod.LOCAL_AXIS,
                         ),
                         g,
                     )
@@ -360,6 +372,8 @@ class _WindowOptimizer:
         self._names = None
         self._treedef = None
         self._enabled_p = False
+        self._default_dst = None
+        self._default_sw = None
 
     def init(self, params):
         """Create the parameter windows and inner state."""
@@ -371,9 +385,11 @@ class _WindowOptimizer:
         for name, leaf in zip(self._names, leaves):
             created = win_mod.win_create(leaf, name, zero_init=zero_init)
             assert created, f"window {name} already exists"
-        if self.mode == "push_sum" and not win_mod._associated_p_enabled:
-            win_mod.turn_on_win_ops_with_associated_p()
-            self._enabled_p = True  # restore on free()
+        if self.mode == "push_sum":
+            # refcounted: freeing one push-sum optimizer must not disable
+            # the p lane under another live one
+            win_mod._acquire_associated_p()
+            self._enabled_p = True
         gopt = _GossipOptimizer(
             self.tx, CommunicationType.empty, order="atc"
         )
@@ -384,7 +400,7 @@ class _WindowOptimizer:
             win_mod.win_free(name)
         self._names = None
         if self._enabled_p:
-            win_mod.turn_off_win_ops_with_associated_p()
+            win_mod._release_associated_p()
             self._enabled_p = False
 
     def params(self):
@@ -438,13 +454,23 @@ class _WindowOptimizer:
         if self.mode == "push_sum":
             # x and the p lane share weights: column-stochastic split over
             # self + out-neighbors (reference optimizers.py:1026-1177).
-            dst = self.dst_weights or [
-                {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]}
-                for r in range(size)
-            ]
+            # Defaults are cached: rebuilding dicts per step is host noise.
+            if self.dst_weights is not None:
+                dst = self.dst_weights
+            else:
+                if self._default_dst is None:
+                    self._default_dst = [
+                        {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]}
+                        for r in range(size)
+                    ]
+                dst = self._default_dst
             sw = self.self_weight
             if sw is None:
-                sw = [1.0 / (len(outs[r]) + 1) for r in range(size)]
+                if self._default_sw is None:
+                    self._default_sw = [
+                        1.0 / (len(outs[r]) + 1) for r in range(size)
+                    ]
+                sw = self._default_sw
             for name, leaf in zip(self._names, new_leaves):
                 win = win_mod._get_win(ctx, name)
                 win.value = leaf  # adopt the adapted x

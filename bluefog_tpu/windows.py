@@ -243,6 +243,10 @@ def _per_rank_edges(
 
 
 def _self_weight_vec(ctx, self_weight, participating) -> np.ndarray:
+    """Per-rank self scale. Scalar broadcasts; a dict is a sparse override
+    (ranks absent from it keep the op default of 1.0 — deliberate, unlike
+    the sequence form which must cover every rank); non-participating ranks
+    are always forced to 1.0."""
     size = ctx.size
     if self_weight is None:
         vec = np.ones((size,))
@@ -383,12 +387,32 @@ def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
     return cached
 
 
+def _lowered_exchange(ctx, win, w_edges):
+    """Cache the host-side lowering (ppermute rounds + slot table) per
+    (weights, window topology): training loops re-dispatch the same pattern
+    for every pytree leaf every step, and the O(size^2) lowering must not
+    sit in that hot path."""
+    key = (
+        "win_lowering",
+        win.in_neighbors,
+        tuple(
+            (int(i), int(j), float(w_edges[i, j]))
+            for i, j in zip(*np.nonzero(w_edges))
+        ),
+    )
+    cached = ctx.op_cache.get(key)
+    if cached is None:
+        rounds = _edge_rounds(w_edges)
+        cached = (rounds, _slot_table(win, rounds))
+        ctx.op_cache[key] = cached
+    return cached
+
+
 def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
     self_vec = _self_weight_vec(ctx, self_weight, participating)
-    rounds = _edge_rounds(w_edges)
-    slot_table = _slot_table(win, rounds)
+    rounds, slot_table = _lowered_exchange(ctx, win, w_edges)
     fn = _exchange_fn(
-        ctx, win, mode, rounds, slot_table, self_vec, _associated_p_enabled
+        ctx, win, mode, rounds, slot_table, self_vec, _p_enabled()
     )
     if x is None:
         x = win.value
@@ -613,7 +637,7 @@ def win_update(
         ctx, win, self_weight, neighbor_weights
     )
     fn = _update_fn(
-        ctx, win, self_vec, w_recv, reset, _associated_p_enabled, participating
+        ctx, win, self_vec, w_recv, reset, _p_enabled(), participating
     )
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
         win.value, win.buffers, win.versions, win.p, win.p_buffers
@@ -673,6 +697,23 @@ def win_poll(handle: int) -> bool:
 
 
 _associated_p_enabled = False
+_p_refcount = 0  # internal holds (push-sum optimizers), refcounted
+
+
+def _p_enabled() -> bool:
+    return _associated_p_enabled or _p_refcount > 0
+
+
+def _acquire_associated_p() -> None:
+    """Internal refcounted enable: each push-sum optimizer holds a
+    reference so freeing one cannot disable the lane under another."""
+    global _p_refcount
+    _p_refcount += 1
+
+
+def _release_associated_p() -> None:
+    global _p_refcount
+    _p_refcount = max(_p_refcount - 1, 0)
 
 
 def turn_on_win_ops_with_associated_p() -> None:

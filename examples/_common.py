@@ -1,0 +1,32 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Shared example bootstrap: build a multi-worker device list.
+
+The reference examples run under ``bfrun -np N`` (one MPI process per
+worker); here a single controller drives N mesh devices. On a machine
+without a multi-chip TPU the examples force an N-device virtual CPU
+platform — the same trick the test harness uses (tests/conftest.py).
+
+Import and call :func:`setup_devices` BEFORE importing jax elsewhere.
+"""
+
+import os
+import sys
+
+# the examples live next to the package; make it importable without install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup_devices(default: int = 8):
+    """Return a list of >= 2 devices, forcing virtual CPU devices if the
+    ambient platform exposes fewer. Honors BLUEFOG_EXAMPLE_DEVICES."""
+    n = int(os.environ.get("BLUEFOG_EXAMPLE_DEVICES", default))
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    import jax
+
+    devices = jax.devices()
+    if len(devices) >= n and devices[0].platform != "cpu":
+        return devices[:n]
+    return jax.devices("cpu")[:n]

@@ -1,0 +1,22 @@
+#!/bin/sh
+# E2E harness: run every example with a timeout, fail fast.
+# TPU-native analogue of reference test/test_all_example.sh.
+set -e
+cd "$(dirname "$0")"
+
+TIMEOUT="${BLUEFOG_EXAMPLE_TIMEOUT:-300}"
+
+run() {
+    echo "=== $* ==="
+    timeout "$TIMEOUT" python "$@" || { echo "FAILED: $*"; exit 1; }
+}
+
+run average_consensus.py
+run decentralized_optimization.py
+run mnist.py --dist-optimizer neighbor_allreduce --epochs 80
+run mnist.py --dist-optimizer gradient_allreduce --epochs 80
+run mnist.py --dist-optimizer win_put --epochs 80
+run benchmark.py --model mlp --num-iters 5
+run benchmark.py --model mlp --dynamic --num-iters 5
+
+echo "ALL EXAMPLES PASSED"

@@ -1,0 +1,63 @@
+"""E2E: run each example as a subprocess on the virtual CPU mesh.
+
+Mirrors the reference's examples-as-e2e-tests strategy
+(test/test_all_example.sh; docs/code_structure.rst:16).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+
+
+def run_example(*argv, timeout=420):
+    env = dict(os.environ)
+    env["BLUEFOG_EXAMPLE_DEVICES"] = "8"
+    proc = subprocess.run(
+        [sys.executable, argv[0], *argv[1:]],
+        cwd=EXAMPLES,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"{argv} failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    return proc.stdout
+
+
+@pytest.mark.example
+def test_average_consensus():
+    out = run_example("average_consensus.py")
+    assert "PASSED" in out
+
+
+@pytest.mark.example
+def test_decentralized_optimization():
+    out = run_example("decentralized_optimization.py", "--maxite", "300")
+    assert "PASSED" in out
+
+
+@pytest.mark.example
+@pytest.mark.parametrize(
+    "optimizer", ["neighbor_allreduce", "gradient_allreduce", "win_put"]
+)
+def test_mnist(optimizer):
+    out = run_example(
+        "mnist.py", "--dist-optimizer", optimizer, "--epochs", "80"
+    )
+    assert "PASSED" in out
+
+
+@pytest.mark.example
+def test_benchmark_static_and_dynamic():
+    out = run_example("benchmark.py", "--model", "mlp", "--num-iters", "3")
+    assert "imgs/sec" in out
+    out = run_example(
+        "benchmark.py", "--model", "mlp", "--dynamic", "--num-iters", "3"
+    )
+    assert "imgs/sec" in out
