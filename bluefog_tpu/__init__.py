@@ -73,6 +73,16 @@ from bluefog_tpu.utility import (
     broadcast_optimizer_state,
     allreduce_parameters,
 )
+from bluefog_tpu.timeline import (
+    timeline_init,
+    timeline_shutdown,
+    timeline_enabled,
+    timeline_start_activity,
+    timeline_end_activity,
+    timeline_context,
+)
+from bluefog_tpu.logging_util import logger, set_log_level
+from bluefog_tpu.watchdog import set_stall_timeout
 from bluefog_tpu.collective.ops import (
     worker_values,
     allreduce,
@@ -270,4 +280,13 @@ __all__ = [
     "broadcast_parameters",
     "broadcast_optimizer_state",
     "allreduce_parameters",
+    "timeline_init",
+    "timeline_shutdown",
+    "timeline_enabled",
+    "timeline_start_activity",
+    "timeline_end_activity",
+    "timeline_context",
+    "logger",
+    "set_log_level",
+    "set_stall_timeout",
 ]

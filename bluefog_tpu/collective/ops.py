@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import timeline as tl
+from bluefog_tpu import watchdog
 from bluefog_tpu.collective import inner
 from bluefog_tpu.collective.plan import (
     CommPlan,
@@ -79,9 +81,22 @@ def poll(handle: int) -> bool:
 
 
 def synchronize(handle: int):
-    """Block until done and return the output (reference mpi_ops.py:916-933)."""
+    """Block until done and return the output (reference mpi_ops.py:916-933).
+
+    The wait is registered with the stall watchdog (the reference's 60-s
+    coordinator stall scan, operations.cc:388-433, re-targeted at host
+    blocking points)."""
     result, post = _handle_map.pop(handle)
-    result = jax.block_until_ready(result)
+    with watchdog.watch(f"synchronize(handle {handle})"):
+        if tl.timeline_enabled():
+            t0 = tl.timeline_now_us()
+            result = jax.block_until_ready(result)
+            tl.timeline_record_complete(
+                f"handle_{handle}", "SYNCHRONIZE", t0,
+                tl.timeline_now_us() - t0,
+            )
+        else:
+            result = jax.block_until_ready(result)
     return post(result) if post is not None else result
 
 
@@ -147,11 +162,25 @@ def _compiled(ctx, name, key, fn, in_specs, out_specs, mesh=None):
     cache_key = (name,) + tuple(key)
     cached = ctx.op_cache.get(cache_key)
     if cached is None:
-        cached = jax.jit(
+        jitted = jax.jit(
             jax.shard_map(
                 fn, mesh=mesh or ctx.mesh, in_specs=in_specs, out_specs=out_specs
             )
         )
+
+        def dispatching(*args, _fn=jitted, _name=name):
+            # host-side ENQUEUE span, the analogue of the reference's
+            # timeline hooks at op submission (torch/mpi_ops.cc:178)
+            if tl.timeline_enabled():
+                t0 = tl.timeline_now_us()
+                out = _fn(*args)
+                tl.timeline_record_complete(
+                    _name, "ENQUEUE", t0, tl.timeline_now_us() - t0
+                )
+                return out
+            return _fn(*args)
+
+        cached = dispatching
         ctx.op_cache[cache_key] = cached
     return cached
 

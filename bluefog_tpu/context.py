@@ -233,12 +233,22 @@ def init(
             devices=devices,
             nodes_per_machine=nodes_per_machine,
         )
+    # Reference behavior: BLUEFOG_TIMELINE=<prefix> activates tracing at
+    # init (operations.cc:464-473).
+    from bluefog_tpu import timeline as _tl
+
+    _tl.maybe_init_from_env()
     return _context
 
 
 def shutdown() -> None:
-    """Drop the global context (reference ``bf.shutdown``)."""
+    """Drop the global context (reference ``bf.shutdown``). Closes a
+    timeline the context implicitly opened from BLUEFOG_TIMELINE."""
     global _context
+    from bluefog_tpu import timeline as _tl
+
+    if _tl.timeline_enabled():
+        _tl.timeline_shutdown()
     with _lock:
         _context = None
 

@@ -1,0 +1,59 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Logging: ``BLUEFOG_LOG_LEVEL``-driven logger for the framework.
+
+The reference splits logging between C++ ``BFLOG`` macros (level from
+``BLUEFOG_LOG_LEVEL``, timestamp toggle ``BLUEFOG_LOG_HIDE_TIME``,
+reference ``common/logging.h:26-75``) and a Python logger named "bluefog"
+(``common/basics.py:27-34``). This runtime is single-controller Python, so
+one configured logger covers both roles; the native timeline writer is the
+only C++ component and reports errors through its return codes.
+
+Levels accepted (reference docs/env_variable.rst:10-23): trace, debug,
+info, warn, error, fatal.
+"""
+
+import logging
+import os
+
+__all__ = ["logger", "set_log_level", "TRACE"]
+
+TRACE = 5  # below logging.DEBUG, parity with the reference's trace level
+logging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logger = logging.getLogger("bluefog_tpu")
+
+
+def set_log_level(level: str) -> None:
+    """Set the framework log level by reference-style name."""
+    if level.lower() not in _LEVELS:
+        raise ValueError(
+            f"unknown log level {level!r}; expected one of {sorted(_LEVELS)}"
+        )
+    logger.setLevel(_LEVELS[level.lower()])
+
+
+def _configure_from_env() -> None:
+    level = os.environ.get("BLUEFOG_LOG_LEVEL", "warn").lower()
+    logger.setLevel(_LEVELS.get(level, logging.WARNING))
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        if os.environ.get("BLUEFOG_LOG_HIDE_TIME"):
+            fmt = "[%(levelname)s] %(name)s: %(message)s"
+        else:
+            fmt = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+        logger.propagate = False
+
+
+_configure_from_env()

@@ -1,0 +1,258 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Tracing subsystem: Chrome-trace timeline + jax.profiler integration.
+
+The reference writes a chrome://tracing JSON per rank from a dedicated C++
+writer thread fed by the communication runtime (reference
+``common/timeline.cc``; activation via ``BLUEFOG_TIMELINE=<prefix>``,
+``operations.cc:464-473``). The TPU-native split:
+
+- **host-side phases** (op enqueue/dispatch, synchronize waits, user
+  activities, optimizer steps) go through the same kind of native writer —
+  ``native/timeline_writer.cc``, a C++ background thread draining a record
+  queue, loaded via ctypes and auto-built with g++ on first use;
+- **device-side phases** (the compiled collectives themselves) are XLA's
+  domain: ``timeline_start(..., profiler=True)`` brackets the session with
+  ``jax.profiler.start_trace`` so the fused ppermute/psum timings land in
+  TensorBoard-compatible traces.
+
+API parity: ``timeline_start_activity`` / ``timeline_end_activity`` /
+``timeline_context`` (reference ``common/basics.py:456-546``), env
+activation via ``BLUEFOG_TIMELINE``.
+"""
+
+import contextlib
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "timeline_init",
+    "timeline_shutdown",
+    "timeline_enabled",
+    "timeline_start_activity",
+    "timeline_end_activity",
+    "timeline_record_complete",
+    "timeline_context",
+]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libbluefog_timeline.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "timeline_writer.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_active = False
+_profiler_dir: Optional[str] = None
+
+
+class _PyWriter:
+    """Pure-Python fallback writer with the same contract as the native
+    library, used only if g++ is unavailable. Single-threaded, synchronous
+    — fine for a fallback, but the native path is the real design."""
+
+    def __init__(self):
+        self._f = None
+        self._first = True
+        self._t0 = time.perf_counter_ns()
+
+    def bf_timeline_start(self, path: bytes) -> int:
+        if self._f is not None:
+            return 0
+        self._f = open(path.decode(), "w")
+        self._f.write("[\n")
+        self._first = True
+        return 1
+
+    def bf_timeline_now_us(self) -> int:
+        return (time.perf_counter_ns() - self._t0) // 1000
+
+    def _emit(self, obj: str) -> None:
+        if self._f is None:
+            return
+        if not self._first:
+            self._f.write(",\n")
+        self._first = False
+        self._f.write(obj)
+
+    @staticmethod
+    def _esc(b: bytes) -> str:
+        # same escaping contract as the native writer (Escape())
+        return (
+            b.decode()
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+        )
+
+    def bf_timeline_record(self, name, cat, ph, pid, tid) -> None:
+        self._emit(
+            '{"name": "%s", "cat": "%s", "ph": "%s", "ts": %d, '
+            '"pid": %d, "tid": %d}'
+            % (
+                self._esc(name), self._esc(cat), ph.decode(),
+                self.bf_timeline_now_us(), pid, tid,
+            )
+        )
+
+    def bf_timeline_record_complete(self, name, cat, pid, tid, ts, dur):
+        self._emit(
+            '{"name": "%s", "cat": "%s", "ph": "X", "ts": %d, "dur": %d, '
+            '"pid": %d, "tid": %d}'
+            % (self._esc(name), self._esc(cat), ts, dur, pid, tid)
+        )
+
+    def bf_timeline_stop(self) -> None:
+        if self._f is not None:
+            self._f.write("\n]\n")
+            self._f.close()
+            self._f = None
+
+
+def _load_native():
+    """Build (once) and load the native writer; fall back to Python."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and os.path.exists(_SRC_PATH):
+            try:
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                        "-pthread", "-o", _SO_PATH, _SRC_PATH,
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                pass
+        if os.path.exists(_SO_PATH):
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+                lib.bf_timeline_start.argtypes = [ctypes.c_char_p]
+                lib.bf_timeline_start.restype = ctypes.c_int
+                lib.bf_timeline_record.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+                    ctypes.c_int, ctypes.c_longlong,
+                ]
+                lib.bf_timeline_record_complete.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                    ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+                ]
+                lib.bf_timeline_now_us.restype = ctypes.c_longlong
+                _lib = lib
+                return _lib
+            except OSError:
+                pass
+        _lib = _PyWriter()
+        return _lib
+
+
+def using_native_writer() -> bool:
+    return isinstance(_load_native(), ctypes.CDLL)
+
+
+def timeline_init(file_path: str, profiler: bool = False) -> bool:
+    """Start the timeline (reference ``bf.timeline_init``, basics.py:456-480).
+
+    ``profiler=True`` additionally starts ``jax.profiler.start_trace`` with
+    traces under ``<file_path>.xplane/`` for the device-side view.
+    """
+    global _active, _profiler_dir
+    ok = bool(_load_native().bf_timeline_start(file_path.encode()))
+    if not ok:
+        return False
+    _active = True
+    if profiler:
+        import jax
+
+        _profiler_dir = file_path + ".xplane"
+        jax.profiler.start_trace(_profiler_dir)
+    return True
+
+
+def timeline_shutdown() -> bool:
+    """Flush and close (reference ``bf.timeline_end``)."""
+    global _active, _profiler_dir
+    if not _active:
+        return False
+    if _profiler_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _profiler_dir = None
+    _load_native().bf_timeline_stop()
+    _active = False
+    return True
+
+
+def timeline_enabled() -> bool:
+    return _active
+
+
+def timeline_start_activity(name: str, activity: str, rank: int = 0,
+                            tid: int = 0) -> bool:
+    """Open an activity span (reference basics.py:482-505)."""
+    if not _active:
+        return False
+    _load_native().bf_timeline_record(
+        name.encode(), activity.encode(), b"B", rank, tid
+    )
+    return True
+
+
+def timeline_end_activity(name: str, activity: str = "", rank: int = 0,
+                          tid: int = 0) -> bool:
+    """Close the most recent span for ``name`` (reference basics.py:507-525)."""
+    if not _active:
+        return False
+    _load_native().bf_timeline_record(
+        name.encode(), activity.encode(), b"E", rank, tid
+    )
+    return True
+
+
+def timeline_record_complete(name: str, activity: str, start_us: int,
+                             dur_us: int, rank: int = 0, tid: int = 0) -> None:
+    """One complete (ph=X) span with explicit timing."""
+    if not _active:
+        return
+    _load_native().bf_timeline_record_complete(
+        name.encode(), activity.encode(), rank, tid, start_us, dur_us
+    )
+
+
+def timeline_now_us() -> int:
+    return int(_load_native().bf_timeline_now_us())
+
+
+@contextlib.contextmanager
+def timeline_context(name: str, activity: str, rank: int = 0):
+    """Span context manager (reference ``bf.timeline_context``,
+    basics.py:527-546)."""
+    timeline_start_activity(name, activity, rank)
+    try:
+        yield
+    finally:
+        timeline_end_activity(name, activity, rank)
+
+
+def maybe_init_from_env() -> bool:
+    """Honor ``BLUEFOG_TIMELINE=<prefix>`` the way the reference runtime
+    does at init (operations.cc:464-473): writes ``<prefix>0.json`` (one
+    controller process == one file). Registers an atexit flush so a
+    program that never calls shutdown still gets valid JSON."""
+    import atexit
+
+    prefix = os.environ.get("BLUEFOG_TIMELINE")
+    if not prefix or _active:
+        return False
+    ok = timeline_init(prefix + "0.json")
+    if ok:
+        atexit.register(timeline_shutdown)
+    return ok

@@ -1,0 +1,95 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Stall watchdog: report blocking waits that exceed a deadline.
+
+The reference's rank-0 coordinator scans its message table every cycle and
+warns, after 60 s, which tensors are stuck waiting on which ranks
+(reference ``common/operations.cc:47,388-433``). Under single-controller
+SPMD there is no negotiation to stall — what can hang is a device program
+(e.g. a collective waiting on a peer that died, or a CPU-emulation
+rendezvous deadlock). So the TPU-native watchdog monitors *host blocking
+points*: every ``synchronize``/``wait`` registers itself, and a daemon
+thread reports (via the framework logger) any wait that outlives
+``BLUEFOG_STALL_TIMEOUT`` seconds (default 60; 0 disables).
+"""
+
+import itertools
+import os
+import threading
+import time
+
+from bluefog_tpu.logging_util import logger
+
+__all__ = ["watch", "stall_timeout", "set_stall_timeout"]
+
+_pending = {}  # id -> (name, start_time, reported)
+_pending_lock = threading.Lock()
+_ids = itertools.count()
+_thread = None
+_timeout = None
+
+
+def stall_timeout() -> float:
+    global _timeout
+    if _timeout is None:
+        _timeout = float(os.environ.get("BLUEFOG_STALL_TIMEOUT", "60"))
+    return _timeout
+
+
+def set_stall_timeout(seconds: float) -> None:
+    """0 disables the watchdog."""
+    global _timeout
+    _timeout = float(seconds)
+
+
+def _monitor() -> None:
+    while True:
+        # short fixed-bound poll so a runtime set_stall_timeout() takes
+        # effect promptly regardless of the previous limit
+        time.sleep(min(max(stall_timeout() / 4, 0.05), 0.25))
+        limit = stall_timeout()
+        if limit <= 0:
+            continue
+        now = time.monotonic()
+        with _pending_lock:
+            for key, (name, t0, reported) in list(_pending.items()):
+                waited = now - t0
+                if waited > limit and not reported:
+                    _pending[key] = (name, t0, True)
+                    logger.error(
+                        "Stall detected: %s has been blocking for %.1f s "
+                        "(limit %.0f s). One or more devices may be hung; "
+                        "on a virtual CPU mesh this is usually a collective "
+                        "rendezvous deadlock (block each dependent dispatch).",
+                        name, waited, limit,
+                    )
+
+
+class watch:
+    """Context manager registering a named blocking wait with the monitor."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.key = None
+
+    def __enter__(self):
+        global _thread
+        if stall_timeout() <= 0:
+            return self
+        if _thread is None:
+            with _pending_lock:
+                if _thread is None:
+                    _thread = threading.Thread(
+                        target=_monitor, name="bluefog-stall-watchdog",
+                        daemon=True,
+                    )
+                    _thread.start()
+        self.key = next(_ids)
+        with _pending_lock:
+            _pending[self.key] = (self.name, time.monotonic(), False)
+        return self
+
+    def __exit__(self, *exc):
+        if self.key is not None:
+            with _pending_lock:
+                _pending.pop(self.key, None)
+        return False
