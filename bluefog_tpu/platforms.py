@@ -1,0 +1,35 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Host-platform helpers shared by the driver contract, examples and tests.
+
+Deliberately imports nothing heavy (no jax): callers use it to mutate
+``XLA_FLAGS`` *before* the CPU backend initializes, which is the only
+window in which the flag has any effect.
+"""
+
+import os
+import re
+
+__all__ = ["ensure_cpu_device_count"]
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def ensure_cpu_device_count(n: int) -> None:
+    """Best-effort bump of the virtual CPU device count.
+
+    XLA honors the LAST occurrence of the flag, so the guard reads the last
+    occurrence and a smaller value is rewritten in place (never appended,
+    which could silently lower a larger count set by an earlier caller).
+    No-op once the CPU backend has initialized — callers must still check
+    ``len(jax.devices("cpu"))`` and fail with an actionable message.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    matches = list(re.finditer(re.escape(_FLAG) + r"=(\d+)", flags))
+    if matches:
+        if int(matches[-1].group(1)) >= n:
+            return
+        last = matches[-1]
+        flags = flags[: last.start()] + f"{_FLAG}={n}" + flags[last.end() :]
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n}").strip()
