@@ -83,6 +83,7 @@ from bluefog_tpu.timeline import (
 )
 from bluefog_tpu.logging_util import logger, set_log_level
 from bluefog_tpu.watchdog import set_stall_timeout
+from bluefog_tpu.watchdog import suspend, resume
 from bluefog_tpu.collective.ops import (
     worker_values,
     allreduce,
@@ -289,4 +290,6 @@ __all__ = [
     "logger",
     "set_log_level",
     "set_stall_timeout",
+    "suspend",
+    "resume",
 ]

@@ -43,6 +43,96 @@ LOCAL_AXIS = "local"
 
 _lock = threading.Lock()
 _context: Optional["BluefogContext"] = None
+_distributed_initialized = False
+
+
+def maybe_init_distributed() -> bool:
+    """Join the multi-host jax.distributed service if the launcher asked.
+
+    ``bfrun-tpu -H host1:4,host2:4 …`` starts one controller process per
+    host with BLUEFOG_COORDINATOR/NUM_PROCESSES/PROCESS_ID set (see
+    :mod:`bluefog_tpu.run.run`); this is the moment the reference's
+    ``mpirun`` process bring-up (run/run.py:180-203) maps to. Returns True
+    when an initialize call was made.
+    """
+    global _distributed_initialized
+    coordinator = os.environ.get("BLUEFOG_COORDINATOR")
+    if not coordinator or _distributed_initialized:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(os.environ["BLUEFOG_NUM_PROCESSES"]),
+        process_id=int(os.environ.get("BLUEFOG_PROCESS_ID", "0")),
+    )
+    _distributed_initialized = True
+    return True
+
+
+def order_devices_for_mesh(devices: Sequence, multi_process: bool) -> List:
+    """Gossip-friendly 1-D ordering of the worker devices (pure helper).
+
+    The machines x local split chunks this ordered list, so the order must
+    be host-contiguous or the "local" psum would span hosts over DCN.
+    Serpentine within each host keeps intra-host hops short; hosts are
+    ordered by process index (DCN neighbors in typical pod wiring).
+    """
+    if not multi_process:
+        return serpentine_device_order(devices)
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    return [
+        d
+        for proc in sorted(by_proc)
+        for d in serpentine_device_order(by_proc[proc])
+    ]
+
+
+def default_nodes_per_machine(
+    devices: Sequence, process_count: int
+) -> Optional[int]:
+    """Machines x local split width when none was requested (pure helper):
+    on a multi-host pod, one "machine" = one controller process's devices;
+    single-host has no natural split (None -> trivial 1-machine split)."""
+    if process_count > 1:
+        return len([d for d in devices if d.process_index == 0])
+    return None
+
+
+def _resolve_devices(requested: Optional[int]) -> List:
+    """Device list honoring BLUEFOG_NUM_WORKERS (set by bfrun-tpu -np).
+
+    Falls back to the virtual CPU platform when the ambient platform has
+    fewer devices than requested (the launcher already raised the CPU
+    device count in XLA_FLAGS); pins the default device to CPU in that
+    case so eager ops cannot land on a different backend than the mesh.
+    """
+    devices = jax.devices()
+    if requested is None:
+        return list(devices)
+    if jax.process_count() > 1:
+        # Multi-host: the global device list is partitioned across
+        # controllers; truncating it would strand some controllers with
+        # none of their addressable devices in the mesh. The per-host
+        # device counts (bfrun-tpu host slots) must simply add up.
+        if len(devices) != requested:
+            raise RuntimeError(
+                f"BLUEFOG_NUM_WORKERS={requested} but the "
+                f"{jax.process_count()}-process pod exposes {len(devices)} "
+                "devices; host slot counts must sum to -np"
+            )
+        return list(devices)
+    if len(devices) < requested:
+        devices = jax.devices("cpu")
+        if devices and len(devices) >= requested:
+            jax.config.update("jax_default_device", devices[0])
+    if len(devices) < requested:
+        raise RuntimeError(
+            f"BLUEFOG_NUM_WORKERS={requested} but only {len(devices)} "
+            "devices exist; launch through bfrun-tpu or set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={requested}"
+        )
+    return list(devices[:requested])
 
 
 class BluefogContext:
@@ -56,23 +146,13 @@ class BluefogContext:
         nodes_per_machine: Optional[int] = None,
     ):
         if devices is None:
-            devices = jax.devices()
-            if jax.process_count() > 1:
-                # The machines x local split below chunks the ordered device
-                # list, so the order must be host-contiguous or the "local"
-                # psum would span hosts over DCN. Serpentine within each
-                # host keeps intra-host hops short; hosts are ordered by
-                # process index (DCN neighbors in typical pod wiring).
-                by_proc: dict = {}
-                for d in devices:
-                    by_proc.setdefault(d.process_index, []).append(d)
-                devices = [
-                    d
-                    for proc in sorted(by_proc)
-                    for d in serpentine_device_order(by_proc[proc])
-                ]
-            else:
-                devices = serpentine_device_order(devices)
+            requested = os.environ.get("BLUEFOG_NUM_WORKERS")
+            devices = _resolve_devices(
+                int(requested) if requested else None
+            )
+            devices = order_devices_for_mesh(
+                devices, jax.process_count() > 1
+            )
         self.devices: List = list(devices)
         self.size: int = len(self.devices)
 
@@ -87,9 +167,9 @@ class BluefogContext:
             env = os.environ.get("BLUEFOG_NODES_PER_MACHINE")
             if env:
                 nodes_per_machine = int(env)
-            elif jax.process_count() > 1:
-                nodes_per_machine = len(
-                    [d for d in self.devices if d.process_index == 0]
+            else:
+                nodes_per_machine = default_nodes_per_machine(
+                    self.devices, jax.process_count()
                 )
         self.local_size: int = nodes_per_machine or self.size
         assert self.size % self.local_size == 0, (
@@ -226,6 +306,7 @@ def init(
     per-process device count on multi-host).
     """
     global _context
+    maybe_init_distributed()
     with _lock:
         _context = BluefogContext(
             topology_fn=topology_fn,

@@ -9,27 +9,47 @@ window in which the flag has any effect.
 import os
 import re
 
-__all__ = ["ensure_cpu_device_count"]
+__all__ = [
+    "ensure_cpu_device_count",
+    "with_cpu_device_count",
+    "with_exact_cpu_device_count",
+]
 
 _FLAG = "--xla_force_host_platform_device_count"
 
 
-def ensure_cpu_device_count(n: int) -> None:
-    """Best-effort bump of the virtual CPU device count.
+def with_exact_cpu_device_count(flags: str, n: int) -> str:
+    """Return ``flags`` with the virtual CPU device count set to EXACTLY
+    ``n`` (pure). Used per host in multi-host launches, where each
+    controller must expose precisely its slot count — an inherited larger
+    value would break the pod-wide device-count invariant."""
+    flags = re.sub(re.escape(_FLAG) + r"=\d+\s*", "", flags).strip()
+    return (flags + f" {_FLAG}={n}").strip()
 
-    XLA honors the LAST occurrence of the flag, so the guard reads the last
-    occurrence and a smaller value is rewritten in place (never appended,
-    which could silently lower a larger count set by an earlier caller).
-    No-op once the CPU backend has initialized — callers must still check
-    ``len(jax.devices("cpu"))`` and fail with an actionable message.
+
+def with_cpu_device_count(flags: str, n: int) -> str:
+    """Return ``flags`` guaranteeing at least ``n`` virtual CPU devices.
+
+    Pure. XLA honors the LAST occurrence of the flag, so the guard reads
+    the last occurrence and a smaller value is rewritten in place (never
+    appended, which could silently lower a larger count set by an earlier
+    caller).
     """
-    flags = os.environ.get("XLA_FLAGS", "")
     matches = list(re.finditer(re.escape(_FLAG) + r"=(\d+)", flags))
     if matches:
         if int(matches[-1].group(1)) >= n:
-            return
+            return flags
         last = matches[-1]
-        flags = flags[: last.start()] + f"{_FLAG}={n}" + flags[last.end() :]
-        os.environ["XLA_FLAGS"] = flags
-    else:
-        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n}").strip()
+        return flags[: last.start()] + f"{_FLAG}={n}" + flags[last.end() :]
+    return (flags + f" {_FLAG}={n}").strip()
+
+
+def ensure_cpu_device_count(n: int) -> None:
+    """Best-effort bump of the virtual CPU device count in ``XLA_FLAGS``.
+
+    No-op once the CPU backend has initialized — callers must still check
+    ``len(jax.devices("cpu"))`` and fail with an actionable message.
+    """
+    os.environ["XLA_FLAGS"] = with_cpu_device_count(
+        os.environ.get("XLA_FLAGS", ""), n
+    )

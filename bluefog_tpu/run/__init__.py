@@ -1,0 +1,13 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Launcher layer: the ``bfrun-tpu`` / ``ibfrun-tpu`` console commands.
+
+TPU-native replacement for the reference launcher (reference
+``run/run.py:58-203``): there is no mpirun to exec and no NIC discovery to
+perform — ICI/DCN wiring is fixed by the pod — so the launcher's job
+reduces to (a) environment preparation (virtual CPU device count for
+single-host dev runs, worker-count and timeline env), (b) multi-host
+process bring-up over ssh with ``jax.distributed`` coordinator
+coordinates, and (c) exec'ing the user command.
+"""
+
+from bluefog_tpu.run import network_util  # noqa: F401

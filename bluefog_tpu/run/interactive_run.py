@@ -1,0 +1,70 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""``ibfrun-tpu``: interactive (notebook/REPL) bluefog_tpu sessions.
+
+Reference counterpart: ``ibfrun`` (reference ``run/interactive_run.py:1-456``)
+starts an ipyparallel cluster — ``ipcontroller`` plus N mpirun'd
+``ipengine`` processes — because the reference needs one OS process per
+worker even in a notebook. Under the single-controller model the notebook
+*is* the controller: all workers are mesh devices of one process, so no
+cluster bring-up exists and ``ibfrun-tpu`` reduces to environment
+preparation (worker count, virtual CPU platform for dev) plus exec'ing an
+interactive interpreter. ``bf.suspend()``/``bf.resume()`` (reference
+``common/basics.py:548-568``) pause the stall watchdog between cells so
+long think-time in a notebook is not reported as a hang.
+
+Usage::
+
+    ibfrun-tpu start -np 8                  # IPython (or python) REPL
+    ibfrun-tpu start -np 8 jupyter lab      # any interactive command
+    ibfrun-tpu stop                         # parity no-op (nothing to stop)
+"""
+
+import os
+import shutil
+import sys
+from typing import Sequence
+
+from bluefog_tpu.run.run import build_child_env, parse_args
+
+__all__ = ["main"]
+
+
+def _interactive_argv(command):
+    if command:
+        return list(command)
+    for candidate in ("ipython", "jupyter"):
+        path = shutil.which(candidate)
+        if path:
+            return [path]
+    return [sys.executable, "-i", "-c", "import bluefog_tpu as bf"]
+
+
+def main(argv: Sequence[str] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("start", "stop"):
+        action, argv = argv[0], argv[1:]
+    else:
+        action = "start"
+    if action == "stop":
+        # The reference tears down ipcontroller/ipengines here; the
+        # single-controller model has no daemons to stop.
+        print("ibfrun-tpu: no cluster processes to stop (single controller)")
+        return 0
+
+    args = parse_args(argv)
+    if args.version:
+        from bluefog_tpu.version import __version__
+
+        print(__version__)
+        return 0
+    env = build_child_env(args, base_env=dict(os.environ))
+    # Interactive sessions have unbounded think time between dispatches;
+    # default the stall watchdog off unless the user explicitly set it.
+    env.setdefault("BLUEFOG_STALL_TIMEOUT", "0")
+    cmd = _interactive_argv(args.command)
+    os.execvpe(cmd[0], cmd, env)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+if __name__ == "__main__":
+    sys.exit(main())

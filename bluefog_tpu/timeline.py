@@ -38,9 +38,28 @@ __all__ = [
     "timeline_context",
 ]
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libbluefog_timeline.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SRC_PATH = os.path.join(_NATIVE_DIR, "timeline_writer.cc")
+
+
+def _so_path() -> str:
+    """Build target for the native writer (resolved lazily, only when the
+    timeline is actually used): next to the source when the package dir is
+    writable (dev checkout), else a VERSIONED per-user cache dir
+    (installed package; versioning invalidates stale builds on upgrade)."""
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return os.path.join(_NATIVE_DIR, "libbluefog_timeline.so")
+    from bluefog_tpu.version import __version__
+
+    cache = os.path.join(
+        os.environ.get(
+            "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+        ),
+        "bluefog_tpu",
+        __version__,
+    )
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libbluefog_timeline.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -118,12 +137,22 @@ def _load_native():
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH) and os.path.exists(_SRC_PATH):
+        try:
+            so_path = _so_path()
+        except OSError:
+            _lib = _PyWriter()  # no writable build location at all
+            return _lib
+        stale = (
+            os.path.exists(so_path)
+            and os.path.exists(_SRC_PATH)
+            and os.path.getmtime(_SRC_PATH) > os.path.getmtime(so_path)
+        )
+        if (not os.path.exists(so_path) or stale) and os.path.exists(_SRC_PATH):
             try:
                 subprocess.run(
                     [
                         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                        "-pthread", "-o", _SO_PATH, _SRC_PATH,
+                        "-pthread", "-o", so_path, _SRC_PATH,
                     ],
                     check=True,
                     capture_output=True,
@@ -131,9 +160,9 @@ def _load_native():
                 )
             except (OSError, subprocess.SubprocessError):
                 pass
-        if os.path.exists(_SO_PATH):
+        if os.path.exists(so_path):
             try:
-                lib = ctypes.CDLL(_SO_PATH)
+                lib = ctypes.CDLL(so_path)
                 lib.bf_timeline_start.argtypes = [ctypes.c_char_p]
                 lib.bf_timeline_start.restype = ctypes.c_int
                 lib.bf_timeline_record.argtypes = [

@@ -19,13 +19,44 @@ import time
 
 from bluefog_tpu.logging_util import logger
 
-__all__ = ["watch", "stall_timeout", "set_stall_timeout"]
+__all__ = [
+    "watch",
+    "stall_timeout",
+    "set_stall_timeout",
+    "suspend",
+    "resume",
+    "is_suspended",
+]
 
 _pending = {}  # id -> (name, start_time, reported)
 _pending_lock = threading.Lock()
 _ids = itertools.count()
 _thread = None
 _timeout = None
+_suspended = False
+
+
+def suspend() -> None:
+    """Pause stall reporting (reference ``bf.suspend``, basics.py:548-568:
+    there it parks the background communication thread between notebook
+    cells; here the blocking-wait monitor is what runs in the background)."""
+    global _suspended
+    _suspended = True
+
+
+def resume() -> None:
+    """Re-arm stall reporting; pending waits restart their clocks so the
+    suspended interval is not counted as a stall."""
+    global _suspended
+    now = time.monotonic()
+    with _pending_lock:
+        for key, (name, _t0, reported) in list(_pending.items()):
+            _pending[key] = (name, now, reported)
+    _suspended = False
+
+
+def is_suspended() -> bool:
+    return _suspended
 
 
 def stall_timeout() -> float:
@@ -47,7 +78,7 @@ def _monitor() -> None:
         # effect promptly regardless of the previous limit
         time.sleep(min(max(stall_timeout() / 4, 0.05), 0.25))
         limit = stall_timeout()
-        if limit <= 0:
+        if limit <= 0 or _suspended:
             continue
         now = time.monotonic()
         with _pending_lock:
