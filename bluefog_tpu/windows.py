@@ -302,12 +302,62 @@ def _slot_table(win: _Window, rounds) -> np.ndarray:
 # -- the compiled exchange body ----------------------------------------------
 
 
-def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
-                 update_p: bool):
-    """Compiled shard_map body for put/accumulate/get.
+def _exchange_core(axis, mode, perms, recv_w, slots_const, self_const,
+                   update_p, max_deg, shape, v, bufs, vers, pv, pbufs, xb):
+    """Per-worker-block exchange math, callable from any shard_map body
+    (the standalone window ops below AND the fused window-optimizer step
+    in :mod:`bluefog_tpu.optimizers` share this single source of truth).
 
     mode 'put': buffers <- w * x (replace), 'acc': buffers += w * x,
-    'get': buffers <- w * value_src (x ignored at call site; value passed).
+    'get': buffers <- w * value_src.
+    """
+    idx = lax.axis_index(axis)
+
+    recvs, precvs = [], []
+    for perm, wvec in zip(perms, recv_w):
+        wsel = jnp.asarray(wvec, v.dtype)[idx]
+        recvs.append(lax.ppermute(xb, axis, perm) * wsel)
+        if update_p:
+            precvs.append(
+                lax.ppermute(pv, axis, perm)
+                * jnp.asarray(wvec, pv.dtype)[idx]
+            )
+    slots = jnp.asarray(slots_const)[idx]          # [max_deg]
+    written = slots >= 0
+    new_pbufs = pbufs
+    if recvs and max_deg:
+        stacked = jnp.stack(recvs)                  # [R, *S]
+        wmask = written.reshape((-1,) + (1,) * len(shape))
+        delivered = jnp.where(
+            wmask, jnp.take(stacked, jnp.clip(slots, 0), axis=0), 0
+        )
+        if mode == "acc":
+            new_bufs = bufs + delivered
+        else:  # put / get replace
+            new_bufs = jnp.where(wmask, delivered, bufs)
+        if update_p:
+            pstacked = jnp.stack(precvs)            # [R]
+            pdelivered = jnp.where(
+                written, jnp.take(pstacked, jnp.clip(slots, 0), axis=0), 0
+            )
+            new_pbufs = (
+                pbufs + pdelivered if mode == "acc"
+                else jnp.where(written, pdelivered, pbufs)
+            )
+        new_vers = vers + written.astype(vers.dtype)
+    else:
+        new_bufs, new_vers = bufs, vers
+
+    sw = jnp.asarray(self_const)[idx]
+    new_v = v * sw.astype(v.dtype)
+    new_p = pv * sw.astype(pv.dtype) if update_p else pv
+    return new_v, new_bufs, new_vers, new_p, new_pbufs
+
+
+def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
+                 update_p: bool):
+    """Compiled shard_map wrapper around :func:`_exchange_core`.
+
     With ``update_p`` the p lane undergoes the identical exchange (reference
     gates this on the associated-p switch; off means p stays untouched).
     """
@@ -325,56 +375,18 @@ def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
 
     slots_const = np.asarray(slot_table, np.int32)
     self_const = np.asarray(self_vec, np.float32)
+    # locals, not the _Window: a closure over `win` would pin its device
+    # arrays in op_cache past win_free
+    max_deg, shape = win.max_deg, win.shape
 
     def body(value, buffers, versions, p, p_buffers, x):
         # blocks carry a leading worker axis of 1
-        v, bufs, vers = value[0], buffers[0], versions[0]
-        pv, pbufs, xb = p[0], p_buffers[0], x[0]
-        idx = lax.axis_index(axis)
-
-        recvs, precvs = [], []
-        for perm, wvec in zip(perms, recv_w):
-            wsel = jnp.asarray(wvec, v.dtype)[idx]
-            recvs.append(lax.ppermute(xb, axis, perm) * wsel)
-            if update_p:
-                precvs.append(
-                    lax.ppermute(pv, axis, perm)
-                    * jnp.asarray(wvec, pv.dtype)[idx]
-                )
-        slots = jnp.asarray(slots_const)[idx]          # [max_deg]
-        written = slots >= 0
-        new_pbufs = pbufs
-        if recvs and win.max_deg:
-            stacked = jnp.stack(recvs)                  # [R, *S]
-            wmask = written.reshape((-1,) + (1,) * len(win.shape))
-            delivered = jnp.where(
-                wmask, jnp.take(stacked, jnp.clip(slots, 0), axis=0), 0
-            )
-            if mode == "acc":
-                new_bufs = bufs + delivered
-            else:  # put / get replace
-                new_bufs = jnp.where(wmask, delivered, bufs)
-            if update_p:
-                pstacked = jnp.stack(precvs)            # [R]
-                pdelivered = jnp.where(
-                    written, jnp.take(pstacked, jnp.clip(slots, 0), axis=0), 0
-                )
-                new_pbufs = (
-                    pbufs + pdelivered if mode == "acc"
-                    else jnp.where(written, pdelivered, pbufs)
-                )
-            new_vers = vers + written.astype(vers.dtype)
-        else:
-            new_bufs, new_vers = bufs, vers
-
-        sw = jnp.asarray(self_const)[idx]
-        new_v = v * sw.astype(v.dtype)
-        new_p = pv * sw.astype(pv.dtype) if update_p else pv
-        expand = lambda t: jnp.expand_dims(t, 0)
-        return (
-            expand(new_v), expand(new_bufs), expand(new_vers),
-            expand(new_p), expand(new_pbufs),
+        outs = _exchange_core(
+            axis, mode, perms, recv_w, slots_const, self_const, update_p,
+            max_deg, shape,
+            value[0], buffers[0], versions[0], p[0], p_buffers[0], x[0],
         )
+        return tuple(jnp.expand_dims(t, 0) for t in outs)
 
     spec = P(ctx_mod.WORKER_AXIS)
     cached = jax.jit(
@@ -554,11 +566,49 @@ def _update_weights(ctx, win, self_weight, neighbor_weights):
     return self_vec, w_recv, participating
 
 
-def _update_fn(ctx, win, self_vec, w_recv, reset, update_p, participating):
-    slot_w = np.zeros((ctx.size, max(win.max_deg, 1)))
+def _update_core(axis, self_const, slot_const, part_const, reset, update_p,
+                 max_deg, v, bufs, vers, pv, pbufs):
+    """Per-worker-block combine math (shared with the fused optimizer
+    step): ``v <- self_w * v + sum_k slot_w[k] * buffer_k``, version reset,
+    optional buffer reset, p lane mirroring."""
+    idx = lax.axis_index(axis)
+    part = jnp.asarray(part_const)[idx]
+    sw = jnp.asarray(self_const, v.dtype)[idx]
+    kw = jnp.asarray(slot_const, v.dtype)[idx]       # [max_deg]
+    new_v = v * sw
+    if max_deg:
+        new_v = new_v + jnp.tensordot(kw, bufs, axes=(0, 0))
+    if update_p:
+        new_p = pv * jnp.asarray(self_const, pv.dtype)[idx]
+        if max_deg:
+            new_p = new_p + jnp.dot(
+                jnp.asarray(slot_const, pv.dtype)[idx], pbufs
+            )
+        new_p = jnp.where(part, new_p, pv)
+        new_pbufs = (
+            jnp.where(part, jnp.zeros_like(pbufs), pbufs)
+            if reset else pbufs
+        )
+    else:
+        new_p, new_pbufs = pv, pbufs
+    # A sitting-out rank keeps its buffers and pending version counts.
+    new_bufs = (
+        jnp.where(part, jnp.zeros_like(bufs), bufs) if reset else bufs
+    )
+    new_vers = jnp.where(part, jnp.zeros_like(vers), vers)
+    return new_v, new_bufs, new_vers, new_p, new_pbufs
+
+
+def _slot_weights(win, w_recv, size) -> np.ndarray:
+    slot_w = np.zeros((size, max(win.max_deg, 1)))
     for r, srcs in enumerate(win.in_neighbors):
         for k, s in enumerate(srcs):
             slot_w[r, k] = w_recv[r, s]
+    return slot_w
+
+
+def _update_fn(ctx, win, self_vec, w_recv, reset, update_p, participating):
+    slot_w = _slot_weights(win, w_recv, ctx.size)
     key = (
         "win_update", tuple(self_vec), tuple(map(tuple, slot_w)), bool(reset),
         update_p, tuple(bool(b) for b in participating),
@@ -571,40 +621,15 @@ def _update_fn(ctx, win, self_vec, w_recv, reset, update_p, participating):
     self_const = np.asarray(self_vec)
     slot_const = np.asarray(slot_w)
     part_const = np.asarray(participating, bool)
+    max_deg = win.max_deg  # local: do not pin `win` in op_cache
 
     def body(value, buffers, versions, p, p_buffers):
-        v, bufs, vers = value[0], buffers[0], versions[0]
-        pv, pbufs = p[0], p_buffers[0]
-        idx = lax.axis_index(axis)
-        part = jnp.asarray(part_const)[idx]
-        sw = jnp.asarray(self_const, v.dtype)[idx]
-        kw = jnp.asarray(slot_const, v.dtype)[idx]       # [max_deg]
-        new_v = v * sw
-        if win.max_deg:
-            new_v = new_v + jnp.tensordot(kw, bufs, axes=(0, 0))
-        if update_p:
-            new_p = pv * jnp.asarray(self_const, pv.dtype)[idx]
-            if win.max_deg:
-                new_p = new_p + jnp.dot(
-                    jnp.asarray(slot_const, pv.dtype)[idx], pbufs
-                )
-            new_p = jnp.where(part, new_p, pv)
-            new_pbufs = (
-                jnp.where(part, jnp.zeros_like(pbufs), pbufs)
-                if reset else pbufs
-            )
-        else:
-            new_p, new_pbufs = pv, pbufs
-        # A sitting-out rank keeps its buffers and pending version counts.
-        new_bufs = (
-            jnp.where(part, jnp.zeros_like(bufs), bufs) if reset else bufs
+        outs = _update_core(
+            axis, self_const, slot_const, part_const, reset, update_p,
+            max_deg,
+            value[0], buffers[0], versions[0], p[0], p_buffers[0],
         )
-        new_vers = jnp.where(part, jnp.zeros_like(vers), vers)
-        expand = lambda t: jnp.expand_dims(t, 0)
-        return (
-            expand(new_v), expand(new_bufs), expand(new_vers),
-            expand(new_p), expand(new_pbufs),
-        )
+        return tuple(jnp.expand_dims(t, 0) for t in outs)
 
     spec = P(ctx_mod.WORKER_AXIS)
     cached = jax.jit(

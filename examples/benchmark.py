@@ -30,7 +30,10 @@ OPTIMIZERS = {
     "allreduce": lambda tx: bf.DistributedAllreduceOptimizer(tx),
     "gradient_allreduce": lambda tx: bf.DistributedGradientAllreduceOptimizer(tx),
     "atc": lambda tx: bf.DistributedAdaptThenCombineOptimizer(tx),
+    "win_put": lambda tx: bf.DistributedWinPutOptimizer(tx),
+    "push_sum": lambda tx: bf.DistributedPushSumOptimizer(tx),
 }
+WINDOW_MODES = ("win_put", "push_sum")
 
 
 def main() -> int:
@@ -72,8 +75,11 @@ def main() -> int:
     params = jax.tree_util.tree_map(
         lambda t: bf.worker_values(np.asarray(t)), variables
     )
+    window_mode = args.dist_optimizer in WINDOW_MODES
     opt = OPTIMIZERS[args.dist_optimizer](optax.sgd(0.01, momentum=0.9))
     if args.dynamic:
+        if window_mode:
+            parser.error("--dynamic applies to the gossip optimizers only")
         from bluefog_tpu.collective.plan import schedule_from_dynamic
 
         topo = tu.ExponentialTwoGraph(size)
@@ -96,17 +102,25 @@ def main() -> int:
 
     grad_fn = jax.jit(jax.vmap(jax.grad(worker_loss)))
 
-    def one_step():
-        grads = grad_fn(params, x, y)
-        return opt.step(params, state, grads)
+    if window_mode:
+        # window optimizers own the iterate: gradients are evaluated at
+        # the current window estimate; step(state, grads)
+        def one_step(params, state):
+            grads = grad_fn(params, x, y)
+            return opt.step(state, grads)
+
+    else:
+        def one_step(params, state):
+            grads = grad_fn(params, x, y)
+            return opt.step(params, state, grads)
 
     for _ in range(args.num_warmup):
-        params, state = one_step()
+        params, state = one_step(params, state)
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
 
     t0 = time.perf_counter()
     for _ in range(args.num_iters):
-        params, state = one_step()
+        params, state = one_step(params, state)
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
     dt = time.perf_counter() - t0
 
