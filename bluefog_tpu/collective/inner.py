@@ -22,10 +22,12 @@ from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 
 __all__ = [
     "weighted_combine",
+    "weighted_combine_operands",
     "neighbor_allreduce",
     "neighbor_allreduce_step",
     "neighbor_allgather",
     "hierarchical_neighbor_allreduce",
+    "hierarchical_neighbor_allreduce_operands",
     "hierarchical_neighbor_allreduce_step",
     "allreduce",
     "allgather",
@@ -57,6 +59,32 @@ def weighted_combine(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndar
     for rnd in plan.rounds:
         recv = lax.ppermute(xw, axis_name, rnd.perm)
         y = y + recv * jnp.asarray(rnd.recv_weights, dtype=wdt)[idx]
+    return y
+
+
+def weighted_combine_operands(
+    x: jnp.ndarray,
+    perms: Tuple[Tuple[Tuple[int, int], ...], ...],
+    self_w: jnp.ndarray,
+    recv_w: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """:func:`weighted_combine` with the weights as runtime *operands*.
+
+    ``perms`` (the communication structure) is traced-static; ``self_w``
+    ([size]) and ``recv_w`` ([len(perms), size]) are device arrays, so
+    per-step varying weights over a fixed edge set reuse ONE compiled
+    program instead of compiling per weight vector (the reference swaps
+    weights every iteration in its dynamic-topology idiom,
+    README.rst:108-123 — the XLA analogue must not retrace for that).
+    """
+    wdt = _weight_dtype(x)
+    idx = lax.axis_index(axis_name)
+    xw = x.astype(wdt)
+    y = xw * self_w[idx].astype(wdt)
+    for r, perm in enumerate(perms):
+        recv = lax.ppermute(xw, axis_name, perm)
+        y = y + recv * recv_w[r, idx].astype(wdt)
     return y
 
 
@@ -136,6 +164,24 @@ def hierarchical_neighbor_allreduce(
     local_size = lax.psum(jnp.ones((), dtype=jnp.float32), local_axis)
     local_sum = lax.psum(x, local_axis)
     combined = weighted_combine(local_sum, machine_plan, machine_axis)
+    return combined / local_size.astype(combined.dtype)
+
+
+def hierarchical_neighbor_allreduce_operands(
+    x: jnp.ndarray,
+    perms: Tuple[Tuple[Tuple[int, int], ...], ...],
+    self_w: jnp.ndarray,
+    recv_w: jnp.ndarray,
+    machine_axis: str,
+    local_axis: str,
+) -> jnp.ndarray:
+    """:func:`hierarchical_neighbor_allreduce` with machine-level weights
+    as runtime operands (see :func:`weighted_combine_operands`)."""
+    local_size = lax.psum(jnp.ones((), dtype=jnp.float32), local_axis)
+    local_sum = lax.psum(x, local_axis)
+    combined = weighted_combine_operands(
+        local_sum, perms, self_w, recv_w, machine_axis
+    )
     return combined / local_size.astype(combined.dtype)
 
 

@@ -42,6 +42,7 @@ __all__ = [
     "CommRound",
     "CommPlan",
     "SchedulePlan",
+    "perms_from_edges",
     "plan_from_matrix",
     "plan_from_topology",
     "plan_from_weights",
@@ -84,6 +85,21 @@ class CommPlan:
     size: int
     self_weights: Tuple[float, ...]
     rounds: Tuple[CommRound, ...]
+
+    @property
+    def perms(self) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+        """The communication *structure* alone (one partial permutation per
+        round) — the cache key for weight-as-operand compiled programs."""
+        return tuple(r.perm for r in self.rounds)
+
+    def weight_operands(self) -> Tuple[np.ndarray, np.ndarray]:
+        """``(self_w [size], recv_w [rounds, size])`` float32 arrays for
+        :func:`bluefog_tpu.collective.inner.weighted_combine_operands`."""
+        self_w = np.asarray(self.self_weights, np.float32)
+        recv = np.zeros((len(self.rounds), self.size), np.float32)
+        for r, rnd in enumerate(self.rounds):
+            recv[r] = rnd.recv_weights
+        return self_w, recv
 
     @functools.cached_property
     def in_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
@@ -159,6 +175,22 @@ class SchedulePlan:
         return max(p.max_in_degree for p in self.plans)
 
 
+def perms_from_edges(
+    edges: Iterable[Tuple[int, int]], size: int
+) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """Group directed edges by ring offset ``(dst - src) % size`` into
+    partial permutations — the single source of truth for the structure
+    lowering (used by plans here and by the window subsystem)."""
+    by_offset: Dict[int, List[Tuple[int, int]]] = {}
+    for i, j in edges:
+        if i == j:
+            continue
+        by_offset.setdefault((j - i) % size, []).append((int(i), int(j)))
+    return tuple(
+        tuple(sorted(by_offset[offset])) for offset in sorted(by_offset)
+    )
+
+
 def plan_from_matrix(
     w: np.ndarray, edges: Optional[Iterable[Tuple[int, int]]] = None
 ) -> CommPlan:
@@ -176,15 +208,8 @@ def plan_from_matrix(
 
     if edges is None:
         edges = zip(*np.nonzero(w))
-    by_offset: Dict[int, List[Tuple[int, int]]] = {}
-    for i, j in edges:
-        if i == j:
-            continue
-        by_offset.setdefault((j - i) % size, []).append((int(i), int(j)))
-
     rounds = []
-    for offset in sorted(by_offset):
-        perm = tuple(sorted(by_offset[offset]))
+    for perm in perms_from_edges(edges, size):
         weights = [0.0] * size
         for s, d in perm:
             weights[d] = float(w[s, d])

@@ -22,6 +22,7 @@ here once; individual functions cite back):
   launch regime both share (one process per host, multi-host DCN).
 """
 
+import itertools
 import os
 import threading
 from typing import Callable, List, Optional, Sequence
@@ -135,6 +136,9 @@ def _resolve_devices(requested: Optional[int]) -> List:
     return list(devices[:requested])
 
 
+_ctx_uid = itertools.count()
+
+
 class BluefogContext:
     """Owns the device mesh, the active topology, and compiled-op caches."""
 
@@ -153,6 +157,9 @@ class BluefogContext:
             devices = order_devices_for_mesh(
                 devices, jax.process_count() > 1
             )
+        # Generation id: state holders acquired against one context (e.g.
+        # the associated-p refcount) must not act on a later context.
+        self.uid: int = next(_ctx_uid)
         self.devices: List = list(devices)
         self.size: int = len(self.devices)
 
@@ -324,11 +331,13 @@ def init(
 
 def shutdown() -> None:
     """Drop the global context (reference ``bf.shutdown``). Closes a
-    timeline the context implicitly opened from BLUEFOG_TIMELINE."""
+    timeline the context implicitly opened from BLUEFOG_TIMELINE; a
+    timeline the user opened with ``timeline_init`` stays open (it is
+    theirs to close)."""
     global _context
     from bluefog_tpu import timeline as _tl
 
-    if _tl.timeline_enabled():
+    if _tl.timeline_env_owned():
         _tl.timeline_shutdown()
     with _lock:
         _context = None

@@ -114,7 +114,8 @@ class _GossipOptimizer:
             order == "grad"
             and communication_type != CommunicationType.allreduce
         ), "gradient gossip is only defined for allreduce communication"
-        self.tx = base_optimizer
+        self._tx_version = 0
+        self._tx = base_optimizer
         self.communication_type = communication_type
         self.order = order
         # Dynamic-topology knobs, reference README.rst:108-123.
@@ -128,12 +129,26 @@ class _GossipOptimizer:
         self.send_neighbor_machines = None
         self._step_count = 0
 
+    @property
+    def tx(self):
+        """The inner optax transformation. Reassigning it retraces the
+        compiled step (the old compiled program would silently keep the
+        stale update rule otherwise); in-place mutation is not detectable —
+        always rebind, as with any jitted closure."""
+        return self._tx
+
+    @tx.setter
+    def tx(self, value):
+        if value is not self._tx:
+            self._tx = value
+            self._tx_version += 1
+
     # -- state ---------------------------------------------------------------
 
     def init(self, params):
         """Per-worker inner-optimizer state, worker-stacked."""
         ctx = ctx_mod.get_context()
-        key = ("opt_init", self._uid) + _aval_key(params)
+        key = ("opt_init", self._uid, self._tx_version) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
             spec = P(ctx_mod.WORKER_AXIS)
@@ -152,25 +167,49 @@ class _GossipOptimizer:
     # -- gossip resolution ---------------------------------------------------
 
     def _gossip_key_and_fn(self, ctx):
-        """Resolve the communication into (cache key piece, block fn)."""
+        """Resolve the communication into (cache-key piece, block fn,
+        weight operands).
+
+        The block fn signature is ``fn(t, step, wops)``. Weight *values*
+        for plan-based gossip ride in ``wops`` as replicated device
+        operands, so the reference's per-iteration weight-reassignment
+        idiom (README.rst:108-123) reuses ONE compiled program per edge
+        structure instead of compiling per weight vector.
+        """
         comm = self.communication_type
-        if self.schedule is not None and comm != CommunicationType.neighbor_allreduce:
+        if self.schedule is not None and comm not in (
+            CommunicationType.neighbor_allreduce,
+            CommunicationType.hierarchical_neighbor_allreduce,
+        ):
             raise ValueError(
                 "opt.schedule (a SchedulePlan) only applies to "
-                "neighbor_allreduce communication; "
+                "neighbor_allreduce or hierarchical communication; "
                 f"this optimizer uses {comm.value!r}"
             )
         if comm == CommunicationType.empty:
-            return ("empty",), lambda t, step: t
+            return ("empty",), (lambda t, step, wops: t), ()
         if comm == CommunicationType.allreduce:
-            return ("allreduce",), lambda t, step: inner.allreduce(
-                t, ctx_mod.WORKER_AXIS, average=True
+            return (
+                ("allreduce",),
+                lambda t, step, wops: inner.allreduce(
+                    t, ctx_mod.WORKER_AXIS, average=True
+                ),
+                (),
             )
         if comm == CommunicationType.neighbor_allreduce:
             if self.schedule is not None:
                 sched = self.schedule
-                return (sched,), lambda t, step: inner.neighbor_allreduce_step(
-                    t, step, sched, ctx_mod.WORKER_AXIS
+                if sched.size != ctx.size:
+                    raise ValueError(
+                        f"opt.schedule is sized for {sched.size} workers "
+                        f"but the mesh has {ctx.size}"
+                    )
+                return (
+                    (sched,),
+                    lambda t, step, wops: inner.neighbor_allreduce_step(
+                        t, step, sched, ctx_mod.WORKER_AXIS
+                    ),
+                    (),
                 )
             plan = col_ops._resolve_plan(
                 ctx,
@@ -179,10 +218,48 @@ class _GossipOptimizer:
                 self.dst_weights,
                 self.enable_topo_check,
             )
-            return (plan,), lambda t, step: inner.neighbor_allreduce(
-                t, plan, ctx_mod.WORKER_AXIS
+            perms = plan.perms
+            self_w, recv_w = plan.weight_operands()
+            return (
+                ("na", perms),
+                lambda t, step, wops: inner.weighted_combine_operands(
+                    t, perms, wops[0], wops[1], ctx_mod.WORKER_AXIS
+                ),
+                (jnp.asarray(self_w), jnp.asarray(recv_w)),
             )
         raise AssertionError(comm)
+
+    def _hier_key_and_fn(self, ctx):
+        """Hierarchical communication: static machine plan (operand
+        weights) or a dynamic machine-level SchedulePlan (the reference's
+        GetExp2DynamicSendRecvMachineRanks training pattern,
+        examples/pytorch_benchmark.py:182-202)."""
+        if self.schedule is not None:
+            sched = self.schedule
+            if sched.size != ctx.machine_size:
+                raise ValueError(
+                    "hierarchical opt.schedule must be machine-level: "
+                    f"sized {sched.size}, but there are {ctx.machine_size} "
+                    "machines"
+                )
+            return (
+                ("hier_sched", sched),
+                lambda t, step, wops: inner.hierarchical_neighbor_allreduce_step(
+                    t, step, sched, ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS
+                ),
+                (),
+            )
+        mplan = self._machine_plan(ctx)
+        perms = mplan.perms
+        self_w, recv_w = mplan.weight_operands()
+        return (
+            ("hier", perms),
+            lambda t, step, wops: inner.hierarchical_neighbor_allreduce_operands(
+                t, perms, wops[0], wops[1],
+                ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS
+            ),
+            (jnp.asarray(self_w), jnp.asarray(recv_w)),
+        )
 
     def _machine_plan(self, ctx):
         if self.neighbor_machine_weights is not None:
@@ -225,37 +302,23 @@ class _GossipOptimizer:
             == CommunicationType.hierarchical_neighbor_allreduce
         )
         if hier:
-            if self.schedule is not None:
-                raise ValueError(
-                    "opt.schedule only applies to neighbor_allreduce "
-                    "communication; this optimizer is hierarchical"
-                )
-            gossip_key = (self._machine_plan(ctx),)
+            gossip_key, gossip_fn, wops = self._hier_key_and_fn(ctx)
+            mesh = ctx.machine_mesh
+            spec = P((ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS))
         else:
-            gossip_key, gossip = self._gossip_key_and_fn(ctx)
+            gossip_key, gossip_fn, wops = self._gossip_key_and_fn(ctx)
+            mesh = ctx.mesh
+            spec = P(ctx_mod.WORKER_AXIS)
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
+            self._tx_version,
         ) + tuple(gossip_key) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
-            if hier:
-                mplan = gossip_key[0]
-
-                def gossip_fn(t, step):
-                    return inner.hierarchical_neighbor_allreduce(
-                        t, mplan, ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS
-                    )
-
-                mesh = ctx.machine_mesh
-                spec = P((ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS))
-            else:
-                gossip_fn = gossip
-                mesh = ctx.mesh
-                spec = P(ctx_mod.WORKER_AXIS)
-
             order = self.order
+            tx = self._tx
 
-            def body(params_b, state_b, grads_b, step):
+            def body(params_b, state_b, grads_b, step, wops):
                 p = _tree_block(params_b)
                 s = _tree_block(state_b)
                 g = _tree_block(grads_b)
@@ -271,13 +334,13 @@ class _GossipOptimizer:
                     )
                 if order == "cta":
                     p = jax.tree_util.tree_map(
-                        lambda t: gossip_fn(t, step), p
+                        lambda t: gossip_fn(t, step, wops), p
                     )
-                updates, s = self.tx.update(g, s, p)
+                updates, s = tx.update(g, s, p)
                 p = optax.apply_updates(p, updates)
                 if order == "atc":
                     p = jax.tree_util.tree_map(
-                        lambda t: gossip_fn(t, step), p
+                        lambda t: gossip_fn(t, step, wops), p
                     )
                 return _tree_restack(p), _tree_restack(s)
 
@@ -285,14 +348,14 @@ class _GossipOptimizer:
                 jax.shard_map(
                     body,
                     mesh=mesh,
-                    in_specs=(spec, spec, spec, P()),
+                    in_specs=(spec, spec, spec, P(), P()),
                     out_specs=(spec, spec),
                 )
             )
             ctx.op_cache[key] = fn
         step_idx = jnp.asarray([self._step_count], jnp.int32)
         self._step_count += 1
-        return fn(params, opt_state, grads, step_idx)
+        return fn(params, opt_state, grads, step_idx, wops)
 
 
 def DistributedGradientAllreduceOptimizer(base_optimizer):
@@ -368,7 +431,8 @@ class _WindowOptimizer:
 
     def __init__(self, base_optimizer, mode: str, window_prefix=None):
         self._uid = next(_opt_uid)  # compiled-step cache key component
-        self.tx = base_optimizer
+        self._tx_version = 0
+        self._tx = base_optimizer
         self.mode = mode  # 'put' | 'get' | 'push_sum'
         self.self_weight = None
         self.dst_weights = None
@@ -387,7 +451,18 @@ class _WindowOptimizer:
         self._default_dst = None
         self._default_sw = None
         self._default_topo_v = None
-        self._step_cache = None  # identity-keyed host-config cache
+
+    @property
+    def tx(self):
+        """Inner optax transformation; reassignment retraces the compiled
+        step (see :class:`_GossipOptimizer`.tx)."""
+        return self._tx
+
+    @tx.setter
+    def tx(self, value):
+        if value is not self._tx:
+            self._tx = value
+            self._tx_version += 1
 
     # -- pack / unpack --------------------------------------------------------
 
@@ -413,6 +488,21 @@ class _WindowOptimizer:
         """Create the combo-vector parameter window and inner state."""
         ctx = ctx_mod.get_context()
         leaves, treedef = jax.tree_util.tree_flatten(params)
+        for i, l in enumerate(leaves):
+            if l.ndim < 1 or l.shape[0] != ctx.size:
+                raise ValueError(
+                    f"window-optimizer parameter leaf {i} must be "
+                    f"worker-stacked [size={ctx.size}, ...]; got shape "
+                    f"{tuple(l.shape)}"
+                )
+            if not jnp.issubdtype(l.dtype, jnp.inexact):
+                raise TypeError(
+                    f"window-optimizer parameter leaf {i} has dtype "
+                    f"{l.dtype}: all leaves share ONE packed float combo "
+                    "window, and integer leaves would round-trip through "
+                    "float on every step (silent truncation). Keep integer "
+                    "state out of the optimized parameter tree."
+                )
         self._treedef = treedef
         self._leaf_shapes = [tuple(l.shape[1:]) for l in leaves]
         self._leaf_dtypes = [l.dtype for l in leaves]
@@ -430,8 +520,10 @@ class _WindowOptimizer:
         assert created, f"window {self._name} already exists"
         if self.mode == "push_sum":
             # refcounted: freeing one push-sum optimizer must not disable
-            # the p lane under another live one
-            win_mod._acquire_associated_p()
+            # the p lane under another live one; the hold is tagged with
+            # the context generation so free() after shutdown/re-init
+            # cannot touch a newer context's count
+            self._p_ctx_uid = win_mod._acquire_associated_p()
             self._enabled_p = True
         gopt = _GossipOptimizer(
             self.tx, CommunicationType.empty, order="atc"
@@ -443,7 +535,7 @@ class _WindowOptimizer:
             win_mod.win_free(self._name)
         self._name = None
         if self._enabled_p:
-            win_mod._release_associated_p()
+            win_mod._release_associated_p(self._p_ctx_uid)
             self._enabled_p = False
 
     def params(self):
@@ -547,58 +639,35 @@ class _WindowOptimizer:
         axis = ctx_mod.WORKER_AXIS
         update_p = win_mod._p_enabled()
 
-        # Steady-state steps skip the whole O(size^2) host resolution: the
-        # resolved program is reused as long as the user has not swapped a
-        # weight knob (identity check — the attribute holds the reference,
-        # so CPython cannot recycle the id), changed the topology, or
-        # changed input avals.
-        sc = self._step_cache
-        if (
-            sc is not None
-            and sc["sw"] is self.self_weight
-            and sc["dst"] is self.dst_weights
-            and sc["src"] is self.src_weights
-            and sc["topo_v"] == ctx.topo_version
-            and sc["p"] == update_p
-            and sc["avals"] == _aval_key((opt_state, grads))
-        ):
-            fn = sc["fn"]
-            (
-                win.value, win.buffers, win.versions, win.p, win.p_buffers,
-                params_out, opt_state,
-            ) = fn(
-                win.value, win.buffers, win.versions, win.p, win.p_buffers,
-                opt_state, grads,
-            )
-            return params_out, opt_state
-
+        # Weight *content* never enters the cache key: the compiled program
+        # is keyed on the communication structure and takes the resolved
+        # weight vectors as replicated operands, so per-step varying
+        # weights (randomized gossip, time-varying push-sum) and in-place
+        # mutation of the weight knobs are both safe and compile-free.
+        # The price is O(size^2) numpy work per step (sub-ms up to ~1k
+        # workers) — deliberately paid: an identity-keyed fast path would
+        # reintroduce the stale-mutation hazard this design removes.
         ex_mode, w_edges, ex_self = self._exchange_config(ctx, win)
-        rounds, slot_table = win_mod._lowered_exchange(ctx, win, w_edges)
+        perms, slot_table = win_mod._lowered_exchange(ctx, win, w_edges)
         up_self, up_w, up_part, reset = self._update_config(ctx, win)
         slot_w = win_mod._slot_weights(win, up_w, ctx.size)
 
-        perms = tuple(r[0] for r in rounds)
-        recv_w = tuple(tuple(r[1]) for r in rounds)
         key = (
-            "wopt_fused_step", self._uid, ex_mode, perms, recv_w,
-            tuple(map(tuple, slot_table)), tuple(ex_self),
-            tuple(up_self), tuple(map(tuple, slot_w)),
-            tuple(bool(b) for b in up_part), reset, update_p,
+            "wopt_fused_step", self._uid, self._tx_version, ex_mode, perms,
+            tuple(map(tuple, slot_table)), reset, update_p,
         ) + _aval_key((opt_state, grads))
         fn = ctx.op_cache.get(key)
         if fn is None:
             slots_const = np.asarray(slot_table, np.int32)
-            ex_self_const = np.asarray(ex_self, np.float32)
-            up_self_const = np.asarray(up_self)
-            slot_w_const = np.asarray(slot_w)
-            part_const = np.asarray(up_part, bool)
             push_sum = self.mode == "push_sum"
+            tx = self._tx
             # locals, not the _Window: a closure over `win` would pin its
             # device arrays in op_cache past opt.free()
             max_deg = win.max_deg
             win_shape = win.shape
 
-            def body(value, buffers, versions, p, p_buffers, s_b, g_b):
+            def body(value, buffers, versions, p, p_buffers, s_b, g_b, wops):
+                ex_recv_w, ex_self_w, up_self_w, up_slot_w, up_part_arr = wops
                 v, bufs, vers = value[0], buffers[0], versions[0]
                 pv, pbufs = p[0], p_buffers[0]
                 s = _tree_block(s_b)
@@ -607,7 +676,7 @@ class _WindowOptimizer:
                 cur = jax.tree_util.tree_unflatten(
                     self._treedef, self._unpack_block(v)
                 )
-                updates, s = self.tx.update(g, s, cur)
+                updates, s = tx.update(g, s, cur)
                 cur = optax.apply_updates(cur, updates)
                 xb = jnp.concatenate(
                     [
@@ -617,13 +686,14 @@ class _WindowOptimizer:
                 )
                 # adopt the adapted x, then exchange + combine
                 v, bufs, vers, pv, pbufs = win_mod._exchange_core(
-                    axis, ex_mode, perms, recv_w, slots_const,
-                    ex_self_const, update_p, max_deg, win_shape,
-                    xb, bufs, vers, pv, pbufs, xb,
+                    axis, ex_mode, perms, slots_const, update_p,
+                    max_deg, win_shape,
+                    xb, bufs, vers, pv, pbufs, xb, ex_recv_w, ex_self_w,
                 )
                 v, bufs, vers, pv, pbufs = win_mod._update_core(
-                    axis, up_self_const, slot_w_const, part_const, reset,
-                    update_p, max_deg, v, bufs, vers, pv, pbufs,
+                    axis, reset, update_p, max_deg,
+                    v, bufs, vers, pv, pbufs,
+                    up_self_w, up_slot_w, up_part_arr,
                 )
                 est = v / pv.astype(v.dtype) if push_sum else v
                 out_leaves = self._unpack_block(est)
@@ -641,22 +711,23 @@ class _WindowOptimizer:
             fn = jax.jit(
                 jax.shard_map(
                     body, mesh=ctx.mesh,
-                    in_specs=(spec,) * 7, out_specs=(spec,) * 7,
+                    in_specs=(spec,) * 7 + (P(),), out_specs=(spec,) * 7,
                 )
             )
             ctx.op_cache[key] = fn
-        self._step_cache = {
-            "sw": self.self_weight, "dst": self.dst_weights,
-            "src": self.src_weights, "topo_v": ctx.topo_version,
-            "p": update_p, "avals": _aval_key((opt_state, grads)),
-            "fn": fn,
-        }
+        wops = (
+            jnp.asarray(win_mod._round_weights(perms, w_edges)),
+            jnp.asarray(np.asarray(ex_self, np.float64)),
+            jnp.asarray(np.asarray(up_self, np.float64)),
+            jnp.asarray(np.asarray(slot_w, np.float64)),
+            jnp.asarray(up_part, bool),
+        )
         (
             win.value, win.buffers, win.versions, win.p, win.p_buffers,
             params_out, opt_state,
         ) = fn(
             win.value, win.buffers, win.versions, win.p, win.p_buffers,
-            opt_state, grads,
+            opt_state, grads, wops,
         )
         return params_out, opt_state
 

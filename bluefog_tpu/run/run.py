@@ -103,6 +103,13 @@ def parse_args(argv: Sequence[str] = None) -> argparse.Namespace:
         "(sets BLUEFOG_TIMELINE).",
     )
     parser.add_argument(
+        "--remote-python", action="store", dest="remote_python",
+        default="python3",
+        help="Interpreter used to run bare .py commands on REMOTE hosts "
+        "(default python3). Locally the launcher's own sys.executable is "
+        "used; its absolute path may not exist on other machines.",
+    )
+    parser.add_argument(
         "--extra-env", action="append", dest="extra_env", default=[],
         metavar="KEY=VALUE",
         help="Extra environment variable for the launched processes "
@@ -169,11 +176,15 @@ def build_child_env(
     return env
 
 
-def _command_argv(command: Sequence[str]) -> List[str]:
-    """Run bare ``script.py`` through the current interpreter."""
+def _command_argv(
+    command: Sequence[str], interpreter: str = None
+) -> List[str]:
+    """Run bare ``script.py`` through an interpreter: the launcher's own
+    ``sys.executable`` locally, a configurable command name for remote
+    hosts (the local absolute path — e.g. a venv — may not exist there)."""
     command = list(command)
     if command and command[0].endswith(".py"):
-        return [sys.executable] + command
+        return [interpreter or sys.executable] + command
     return command
 
 
@@ -225,8 +236,14 @@ def build_host_commands(
         env_prefix = ["env"] + [
             f"{k}={v}" for k, v in sorted(proc_env.items())
         ]
-        argv = env_prefix + _command_argv(args.command)
-        if network_util.is_local_address(hs.host):
+        local = network_util.is_local_address(hs.host)
+        argv = env_prefix + _command_argv(
+            args.command,
+            interpreter=None if local else getattr(
+                args, "remote_python", "python3"
+            ),
+        )
+        if local:
             commands.append((hs.host, argv))
         else:
             ssh = ["ssh", "-o", "BatchMode=yes"]

@@ -65,6 +65,9 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _active = False
 _profiler_dir: Optional[str] = None
+_env_owned = False  # True when the active timeline was opened from
+                    # BLUEFOG_TIMELINE by init(); only that one is closed
+                    # implicitly by bf.shutdown()
 
 
 class _PyWriter:
@@ -192,11 +195,12 @@ def timeline_init(file_path: str, profiler: bool = False) -> bool:
     ``profiler=True`` additionally starts ``jax.profiler.start_trace`` with
     traces under ``<file_path>.xplane/`` for the device-side view.
     """
-    global _active, _profiler_dir
+    global _active, _profiler_dir, _env_owned
     ok = bool(_load_native().bf_timeline_start(file_path.encode()))
     if not ok:
         return False
     _active = True
+    _env_owned = False  # an explicit user init owns its own lifecycle
     if profiler:
         import jax
 
@@ -207,7 +211,7 @@ def timeline_init(file_path: str, profiler: bool = False) -> bool:
 
 def timeline_shutdown() -> bool:
     """Flush and close (reference ``bf.timeline_end``)."""
-    global _active, _profiler_dir
+    global _active, _profiler_dir, _env_owned
     if not _active:
         return False
     if _profiler_dir is not None:
@@ -217,7 +221,15 @@ def timeline_shutdown() -> bool:
         _profiler_dir = None
     _load_native().bf_timeline_stop()
     _active = False
+    _env_owned = False
     return True
+
+
+def timeline_env_owned() -> bool:
+    """True when the active timeline was opened implicitly from
+    BLUEFOG_TIMELINE at init (then ``bf.shutdown()`` closes it; a timeline
+    the *user* opened with :func:`timeline_init` is theirs to close)."""
+    return _active and _env_owned
 
 
 def timeline_enabled() -> bool:
@@ -278,10 +290,12 @@ def maybe_init_from_env() -> bool:
     program that never calls shutdown still gets valid JSON."""
     import atexit
 
+    global _env_owned
     prefix = os.environ.get("BLUEFOG_TIMELINE")
     if not prefix or _active:
         return False
     ok = timeline_init(prefix + "0.json")
     if ok:
+        _env_owned = True
         atexit.register(timeline_shutdown)
     return ok

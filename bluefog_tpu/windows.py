@@ -266,18 +266,19 @@ def _self_weight_vec(ctx, self_weight, participating) -> np.ndarray:
     return np.where(participating, vec, 1.0)
 
 
-def _edge_rounds(w: np.ndarray):
-    """Group directed edges (nonzeros of w) by ring offset into ppermute
-    rounds; returns (perm, recv_weight_vector) per round. Reuses the plan
-    lowering (self weights are irrelevant here: the diagonal is zero and
-    window ops apply self scaling separately)."""
-    from bluefog_tpu.collective.plan import plan_from_matrix
+def _round_weights(perms, w: np.ndarray) -> np.ndarray:
+    """[rounds, size] receiver-side weights for each perm round, read out
+    of the edge-weight matrix ``w`` (w[src, dst]). float64 so an x64
+    session's float64 windows see full-precision weights (the exchange
+    casts to the window dtype in-program)."""
+    out = np.zeros((len(perms), w.shape[0]), np.float64)
+    for r, perm in enumerate(perms):
+        for s, d in perm:
+            out[r, d] = w[s, d]
+    return out
 
-    plan = plan_from_matrix(np.asarray(w) * (1 - np.eye(w.shape[0])))
-    return [(r.perm, np.asarray(r.recv_weights)) for r in plan.rounds]
 
-
-def _slot_table(win: _Window, rounds) -> np.ndarray:
+def _slot_table(win: _Window, perms) -> np.ndarray:
     """[size, max_deg] round index that wrote each window buffer slot this
     call, -1 where untouched. Writes to a rank that is not a create-time
     in-neighbor have no buffer slot -> error (parity: the reference has no
@@ -287,7 +288,7 @@ def _slot_table(win: _Window, rounds) -> np.ndarray:
         {s: k for k, s in enumerate(srcs)} for srcs in win.in_neighbors
     ]
     table = np.full((size, max(win.max_deg, 1)), -1, np.int32)
-    for r, (perm, _) in enumerate(rounds):
+    for r, perm in enumerate(perms):
         for s, d in perm:
             if s not in slot_of[d]:
                 raise ValueError(
@@ -302,25 +303,26 @@ def _slot_table(win: _Window, rounds) -> np.ndarray:
 # -- the compiled exchange body ----------------------------------------------
 
 
-def _exchange_core(axis, mode, perms, recv_w, slots_const, self_const,
-                   update_p, max_deg, shape, v, bufs, vers, pv, pbufs, xb):
+def _exchange_core(axis, mode, perms, slots_const, update_p, max_deg, shape,
+                   v, bufs, vers, pv, pbufs, xb, recv_w, self_w):
     """Per-worker-block exchange math, callable from any shard_map body
     (the standalone window ops below AND the fused window-optimizer step
     in :mod:`bluefog_tpu.optimizers` share this single source of truth).
 
     mode 'put': buffers <- w * x (replace), 'acc': buffers += w * x,
-    'get': buffers <- w * value_src.
+    'get': buffers <- w * value_src. ``recv_w`` ([rounds, size]) and
+    ``self_w`` ([size]) are runtime operands: per-step varying weights
+    (randomized gossip, time-varying push-sum) reuse one compiled program.
     """
     idx = lax.axis_index(axis)
 
     recvs, precvs = [], []
-    for perm, wvec in zip(perms, recv_w):
-        wsel = jnp.asarray(wvec, v.dtype)[idx]
-        recvs.append(lax.ppermute(xb, axis, perm) * wsel)
+    for r, perm in enumerate(perms):
+        wsel = recv_w[r, idx]
+        recvs.append(lax.ppermute(xb, axis, perm) * wsel.astype(v.dtype))
         if update_p:
             precvs.append(
-                lax.ppermute(pv, axis, perm)
-                * jnp.asarray(wvec, pv.dtype)[idx]
+                lax.ppermute(pv, axis, perm) * wsel.astype(pv.dtype)
             )
     slots = jnp.asarray(slots_const)[idx]          # [max_deg]
     written = slots >= 0
@@ -348,25 +350,25 @@ def _exchange_core(axis, mode, perms, recv_w, slots_const, self_const,
     else:
         new_bufs, new_vers = bufs, vers
 
-    sw = jnp.asarray(self_const)[idx]
+    sw = self_w[idx]
     new_v = v * sw.astype(v.dtype)
     new_p = pv * sw.astype(pv.dtype) if update_p else pv
     return new_v, new_bufs, new_vers, new_p, new_pbufs
 
 
-def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
+def _exchange_fn(ctx, win: _Window, mode: str, perms, slot_table,
                  update_p: bool):
     """Compiled shard_map wrapper around :func:`_exchange_core`.
 
-    With ``update_p`` the p lane undergoes the identical exchange (reference
+    Keyed on the communication *structure* (perms + slot table), never on
+    weight values — those arrive as replicated operands at dispatch. With
+    ``update_p`` the p lane undergoes the identical exchange (reference
     gates this on the associated-p switch; off means p stays untouched).
     """
     axis = ctx_mod.WORKER_AXIS
-    perms = tuple(r[0] for r in rounds)
-    recv_w = tuple(tuple(r[1]) for r in rounds)
     key = (
-        "win_exchange", mode, perms, recv_w,
-        tuple(map(tuple, slot_table)), tuple(self_vec), update_p,
+        "win_exchange", mode, perms,
+        tuple(map(tuple, slot_table)), update_p,
         win.shape, str(win.dtype),
     )
     cached = ctx.op_cache.get(key)
@@ -374,17 +376,16 @@ def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
         return cached
 
     slots_const = np.asarray(slot_table, np.int32)
-    self_const = np.asarray(self_vec, np.float32)
     # locals, not the _Window: a closure over `win` would pin its device
     # arrays in op_cache past win_free
     max_deg, shape = win.max_deg, win.shape
 
-    def body(value, buffers, versions, p, p_buffers, x):
+    def body(value, buffers, versions, p, p_buffers, x, recv_w, self_w):
         # blocks carry a leading worker axis of 1
         outs = _exchange_core(
-            axis, mode, perms, recv_w, slots_const, self_const, update_p,
-            max_deg, shape,
+            axis, mode, perms, slots_const, update_p, max_deg, shape,
             value[0], buffers[0], versions[0], p[0], p_buffers[0], x[0],
+            recv_w, self_w,
         )
         return tuple(jnp.expand_dims(t, 0) for t in outs)
 
@@ -392,7 +393,7 @@ def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
     cached = jax.jit(
         jax.shard_map(
             body, mesh=ctx.mesh,
-            in_specs=(spec,) * 6, out_specs=(spec,) * 5,
+            in_specs=(spec,) * 6 + (P(), P()), out_specs=(spec,) * 5,
         )
     )
     ctx.op_cache[key] = cached
@@ -401,31 +402,27 @@ def _exchange_fn(ctx, win: _Window, mode: str, rounds, slot_table, self_vec,
 
 def _lowered_exchange(ctx, win, w_edges):
     """Cache the host-side lowering (ppermute rounds + slot table) per
-    (weights, window topology): training loops re-dispatch the same pattern
-    for every pytree leaf every step, and the O(size^2) lowering must not
-    sit in that hot path."""
-    key = (
-        "win_lowering",
-        win.in_neighbors,
-        tuple(
-            (int(i), int(j), float(w_edges[i, j]))
-            for i, j in zip(*np.nonzero(w_edges))
-        ),
+    (edge structure, window topology): training loops re-dispatch the same
+    pattern for every step, and the O(size^2) lowering must not sit in that
+    hot path. Weight *values* are deliberately not in the key."""
+    edges = tuple(
+        (int(i), int(j)) for i, j in zip(*np.nonzero(w_edges))
     )
+    key = ("win_lowering", win.in_neighbors, edges)
     cached = ctx.op_cache.get(key)
     if cached is None:
-        rounds = _edge_rounds(w_edges)
-        cached = (rounds, _slot_table(win, rounds))
+        from bluefog_tpu.collective.plan import perms_from_edges
+
+        perms = perms_from_edges(edges, w_edges.shape[0])
+        cached = (perms, _slot_table(win, perms))
         ctx.op_cache[key] = cached
     return cached
 
 
 def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
     self_vec = _self_weight_vec(ctx, self_weight, participating)
-    rounds, slot_table = _lowered_exchange(ctx, win, w_edges)
-    fn = _exchange_fn(
-        ctx, win, mode, rounds, slot_table, self_vec, _p_enabled()
-    )
+    perms, slot_table = _lowered_exchange(ctx, win, w_edges)
+    fn = _exchange_fn(ctx, win, mode, perms, slot_table, _p_enabled())
     if x is None:
         x = win.value
     else:
@@ -436,7 +433,9 @@ def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
                 f"{tuple(x.shape[1:])}"
             )
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
-        win.value, win.buffers, win.versions, win.p, win.p_buffers, x
+        win.value, win.buffers, win.versions, win.p, win.p_buffers, x,
+        jnp.asarray(_round_weights(perms, w_edges)),
+        jnp.asarray(np.asarray(self_vec, np.float64)),
     )
     return win
 
@@ -566,24 +565,23 @@ def _update_weights(ctx, win, self_weight, neighbor_weights):
     return self_vec, w_recv, participating
 
 
-def _update_core(axis, self_const, slot_const, part_const, reset, update_p,
-                 max_deg, v, bufs, vers, pv, pbufs):
+def _update_core(axis, reset, update_p, max_deg,
+                 v, bufs, vers, pv, pbufs, self_w, slot_w, part_arr):
     """Per-worker-block combine math (shared with the fused optimizer
     step): ``v <- self_w * v + sum_k slot_w[k] * buffer_k``, version reset,
-    optional buffer reset, p lane mirroring."""
+    optional buffer reset, p lane mirroring. ``self_w`` [size], ``slot_w``
+    [size, max_deg] and ``part_arr`` [size] are runtime operands."""
     idx = lax.axis_index(axis)
-    part = jnp.asarray(part_const)[idx]
-    sw = jnp.asarray(self_const, v.dtype)[idx]
-    kw = jnp.asarray(slot_const, v.dtype)[idx]       # [max_deg]
+    part = part_arr[idx]
+    sw = self_w[idx].astype(v.dtype)
+    kw = slot_w[idx].astype(v.dtype)                 # [max_deg]
     new_v = v * sw
     if max_deg:
         new_v = new_v + jnp.tensordot(kw, bufs, axes=(0, 0))
     if update_p:
-        new_p = pv * jnp.asarray(self_const, pv.dtype)[idx]
+        new_p = pv * self_w[idx].astype(pv.dtype)
         if max_deg:
-            new_p = new_p + jnp.dot(
-                jnp.asarray(slot_const, pv.dtype)[idx], pbufs
-            )
+            new_p = new_p + jnp.dot(slot_w[idx].astype(pv.dtype), pbufs)
         new_p = jnp.where(part, new_p, pv)
         new_pbufs = (
             jnp.where(part, jnp.zeros_like(pbufs), pbufs)
@@ -607,34 +605,32 @@ def _slot_weights(win, w_recv, size) -> np.ndarray:
     return slot_w
 
 
-def _update_fn(ctx, win, self_vec, w_recv, reset, update_p, participating):
-    slot_w = _slot_weights(win, w_recv, ctx.size)
+def _update_fn(ctx, win, reset, update_p):
+    """Structure-keyed compiled combine; weight vectors and the
+    participation mask arrive as replicated operands at dispatch."""
     key = (
-        "win_update", tuple(self_vec), tuple(map(tuple, slot_w)), bool(reset),
-        update_p, tuple(bool(b) for b in participating),
+        "win_update", bool(reset), update_p, win.max_deg,
         win.shape, str(win.dtype),
     )
     cached = ctx.op_cache.get(key)
     if cached is not None:
         return cached
     axis = ctx_mod.WORKER_AXIS
-    self_const = np.asarray(self_vec)
-    slot_const = np.asarray(slot_w)
-    part_const = np.asarray(participating, bool)
     max_deg = win.max_deg  # local: do not pin `win` in op_cache
 
-    def body(value, buffers, versions, p, p_buffers):
+    def body(value, buffers, versions, p, p_buffers, self_w, slot_w, part):
         outs = _update_core(
-            axis, self_const, slot_const, part_const, reset, update_p,
-            max_deg,
+            axis, reset, update_p, max_deg,
             value[0], buffers[0], versions[0], p[0], p_buffers[0],
+            self_w, slot_w, part,
         )
         return tuple(jnp.expand_dims(t, 0) for t in outs)
 
     spec = P(ctx_mod.WORKER_AXIS)
     cached = jax.jit(
         jax.shard_map(
-            body, mesh=ctx.mesh, in_specs=(spec,) * 5, out_specs=(spec,) * 5
+            body, mesh=ctx.mesh,
+            in_specs=(spec,) * 5 + (P(), P(), P()), out_specs=(spec,) * 5,
         )
     )
     ctx.op_cache[key] = cached
@@ -661,11 +657,12 @@ def win_update(
     self_vec, w_recv, participating = _update_weights(
         ctx, win, self_weight, neighbor_weights
     )
-    fn = _update_fn(
-        ctx, win, self_vec, w_recv, reset, _p_enabled(), participating
-    )
+    fn = _update_fn(ctx, win, reset, _p_enabled())
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
-        win.value, win.buffers, win.versions, win.p, win.p_buffers
+        win.value, win.buffers, win.versions, win.p, win.p_buffers,
+        jnp.asarray(np.asarray(self_vec, np.float64)),
+        jnp.asarray(np.asarray(_slot_weights(win, w_recv, ctx.size), np.float64)),
+        jnp.asarray(participating, bool),
     )
     return win.value
 
@@ -721,37 +718,55 @@ def win_poll(handle: int) -> bool:
     return col_ops.poll(handle)
 
 
-_associated_p_enabled = False
-_p_refcount = 0  # internal holds (push-sum optimizers), refcounted
+def _p_state(ctx) -> Dict[str, int]:
+    """Associated-p switch + refcount live ON the context so
+    ``bf.shutdown()`` (and re-init) cannot leak the lane state across
+    sessions — the reference's flag likewise dies with its global state."""
+    if not hasattr(ctx, "p_flags"):
+        ctx.p_flags = {"enabled": False, "refcount": 0}
+    return ctx.p_flags
 
 
 def _p_enabled() -> bool:
-    return _associated_p_enabled or _p_refcount > 0
+    st = _p_state(ctx_mod.get_context())
+    return bool(st["enabled"]) or st["refcount"] > 0
 
 
-def _acquire_associated_p() -> None:
+def _acquire_associated_p() -> int:
     """Internal refcounted enable: each push-sum optimizer holds a
-    reference so freeing one cannot disable the lane under another."""
-    global _p_refcount
-    _p_refcount += 1
+    reference so freeing one cannot disable the lane under another.
+    Returns the context generation id the hold was taken against."""
+    ctx = ctx_mod.get_context()
+    _p_state(ctx)["refcount"] += 1
+    return ctx.uid
 
 
-def _release_associated_p() -> None:
-    global _p_refcount
-    _p_refcount = max(_p_refcount - 1, 0)
+def _release_associated_p(ctx_uid: int) -> None:
+    """Release a hold taken by :func:`_acquire_associated_p` — only against
+    the SAME context generation: releasing a hold from a shut-down session
+    must not decrement a newer context's live refcount."""
+    if not ctx_mod.is_initialized():
+        return  # context already shut down; its p state died with it
+    ctx = ctx_mod.get_context()
+    if ctx.uid != ctx_uid:
+        return
+    st = _p_state(ctx)
+    st["refcount"] = max(st["refcount"] - 1, 0)
 
 
 def turn_on_win_ops_with_associated_p() -> None:
     """Enable the associated-p lane (reference mpi_ops.py:1421-1434). While
     off, window ops leave every p at its initial 1.0 — the same gating the
-    reference applies inside its op callbacks (mpi_win_ops.cc:492-497)."""
-    global _associated_p_enabled
-    _associated_p_enabled = True
+    reference applies inside its op callbacks (mpi_win_ops.cc:492-497).
+    The switch lives on the context (it does not survive shutdown), so it
+    requires an initialized session — same contract as the window ops."""
+    _p_state(ctx_mod.get_context())["enabled"] = True
 
 
 def turn_off_win_ops_with_associated_p() -> None:
-    global _associated_p_enabled
-    _associated_p_enabled = False
+    if not ctx_mod.is_initialized():
+        return  # nothing to turn off: the state died with the context
+    _p_state(ctx_mod.get_context())["enabled"] = False
 
 
 def win_associated_p(name: str = None, rank: Optional[int] = None):

@@ -1,0 +1,287 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Regression tests for advisor findings (rounds 2-3).
+
+Each test pins one previously-reported defect:
+
+- timeline ownership: ``bf.shutdown()`` must not close a timeline the
+  *user* opened (only one init() opened from BLUEFOG_TIMELINE);
+- associated-p state must die with the context (no module-global leak
+  across shutdown/re-init);
+- per-step varying exchange weights must NOT grow the compiled-program
+  cache (weights are operands, structure is the key);
+- rebinding ``opt.tx`` must retrace (stale compiled update rule);
+- mutating a weight-knob dict in place must take effect next step;
+- window-optimizer ``init`` must reject wrongly-shaped and integer
+  leaves instead of silently reinterpreting them.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import timeline as tl
+from bluefog_tpu import topology as tu
+from bluefog_tpu import windows as win_mod
+
+SIZE = 8
+DIM = 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    if bf.is_initialized():
+        bf.win_free()
+        bf.shutdown()
+    if tl.timeline_enabled():
+        tl.timeline_shutdown()
+
+
+def targets():
+    rng = np.random.RandomState(0)
+    return rng.randn(SIZE, DIM).astype(np.float32)
+
+
+# -- timeline ownership ------------------------------------------------------
+
+
+def test_shutdown_keeps_user_opened_timeline(tmp_path):
+    path = str(tmp_path / "user_timeline.json")
+    assert tl.timeline_init(path)
+    bf.shutdown()
+    # the user opened it; shutdown must leave it active for them to close
+    assert tl.timeline_enabled()
+    assert tl.timeline_shutdown()
+
+
+def test_shutdown_closes_env_opened_timeline(tmp_path, monkeypatch, cpu_devices):
+    bf.shutdown()
+    prefix = str(tmp_path / "env_timeline_")
+    monkeypatch.setenv("BLUEFOG_TIMELINE", prefix)
+    bf.init(devices=cpu_devices[:SIZE])
+    assert tl.timeline_enabled() and tl.timeline_env_owned()
+    bf.shutdown()
+    assert not tl.timeline_enabled()
+    assert os.path.exists(prefix + "0.json")
+
+
+# -- associated-p lifecycle --------------------------------------------------
+
+
+def test_associated_p_state_dies_with_context(cpu_devices):
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: np.ones(DIM, np.float32))}
+    opt.init(params)
+    assert win_mod._p_enabled()
+    bf.shutdown()  # context (and its p refcount) gone
+    bf.init(devices=cpu_devices[:SIZE])
+    assert not win_mod._p_enabled()  # no leak into the new context
+    opt.free()  # releasing against the NEW context must not underflow
+    assert not win_mod._p_enabled()
+
+
+def test_turn_on_p_scoped_to_context(cpu_devices):
+    bf.turn_on_win_ops_with_associated_p()
+    assert win_mod._p_enabled()
+    bf.shutdown()
+    bf.init(devices=cpu_devices[:SIZE])
+    assert not win_mod._p_enabled()
+
+
+# -- varying weights never recompile ----------------------------------------
+
+
+def test_win_put_varying_weights_single_program():
+    """Time-varying dst weights over a fixed edge set (randomized gossip,
+    push-sum with decaying weights) must reuse ONE compiled exchange."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    x = bf.worker_values(lambda r: np.full(DIM, float(r), np.float32))
+    bf.win_create(x, "vary")
+    ctx = bf.get_context()
+    outs = ctx.out_neighbor_ranks()
+    rng = np.random.RandomState(3)
+
+    def put(step):
+        w = 0.1 + 0.8 * rng.rand()
+        bf.win_put(
+            name="vary",
+            dst_weights=[{d: w for d in outs[r]} for r in range(SIZE)],
+            self_weight=1.0 - w,
+        )
+
+    put(0)
+    n_after_first = len(ctx.op_cache)
+    for t in range(1, 8):
+        put(t)
+    assert len(ctx.op_cache) == n_after_first
+
+
+def test_win_update_varying_weights_single_program():
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = bf.worker_values(lambda r: np.full(DIM, float(r), np.float32))
+    bf.win_create(x, "vary_up")
+    ctx = bf.get_context()
+    ins = ctx.in_neighbor_ranks()
+
+    def update(t):
+        sw = 0.2 + 0.1 * (t % 5)
+        nw = [
+            {s: (1.0 - sw) / len(ins[r]) for s in ins[r]} for r in range(SIZE)
+        ]
+        bf.win_update("vary_up", self_weight=sw, neighbor_weights=nw)
+
+    update(0)
+    n_after_first = len(ctx.op_cache)
+    for t in range(1, 8):
+        update(t)
+    assert len(ctx.op_cache) == n_after_first
+
+
+def test_window_optimizer_varying_weights_single_program():
+    """The reference's time-varying push-sum pattern
+    (test_windows.py push-sum with per-step weights) through the fused
+    optimizer step: one program, many weight vectors."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    c = targets()
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    ctx = bf.get_context()
+    outs = ctx.out_neighbor_ranks()
+    cur = params
+    rng = np.random.RandomState(4)
+    sizes = []
+    for t in range(8):
+        w = 0.2 + 0.6 * rng.rand()
+        opt.dst_weights = [{d: w for d in outs[r]} for r in range(SIZE)]
+        opt.self_weight = [1.0 - w] * SIZE
+        grads = {"w": cur["w"] - jnp.asarray(c)}
+        cur, state = opt.step(state, grads)
+        sizes.append(len(ctx.op_cache))
+    assert sizes[-1] == sizes[0], sizes
+    opt.free()
+
+
+def test_gossip_optimizer_varying_weight_values_single_program():
+    """Same edge set, different weight VALUES each step: the gossip
+    optimizer must not compile per weight vector (reference idiom
+    README.rst:108-123 with continuously-varying weights)."""
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.2))
+    c = targets()
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    ctx = bf.get_context()
+    ins = ctx.in_neighbor_ranks()
+    outs = ctx.out_neighbor_ranks()
+    rng = np.random.RandomState(5)
+    sizes = []
+    for t in range(8):
+        sw = 0.3 + 0.4 * rng.rand()
+        opt.self_weight = sw
+        opt.src_weights = [
+            {s: (1.0 - sw) / len(ins[r]) for s in ins[r]} for r in range(SIZE)
+        ]
+        opt.dst_weights = [list(outs[r]) for r in range(SIZE)]
+        grads = {"w": params["w"] - jnp.asarray(c)}
+        params, state = opt.step(params, state, grads)
+        sizes.append(len(ctx.op_cache))
+    assert sizes[-1] == sizes[0], sizes
+
+
+# -- tx rebind ---------------------------------------------------------------
+
+
+def test_tx_rebind_retraces_gossip_optimizer():
+    c = targets()
+    opt = bf.DistributedAdaptWithCombineOptimizer(
+        optax.sgd(0.5), bf.CommunicationType.empty
+    )
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    grads = {"w": jnp.ones_like(params["w"])}
+    params, state = opt.step(params, state, grads)
+    moved = np.asarray(params["w"]).copy()
+    opt.tx = optax.sgd(0.0)  # rebind: learning rate zero
+    state = opt.init(params)
+    params2, _ = opt.step(params, state, grads)
+    # a stale compiled step would keep lr=0.5 and keep moving
+    np.testing.assert_allclose(np.asarray(params2["w"]), moved, atol=1e-7)
+
+
+def test_tx_rebind_retraces_window_optimizer():
+    c = targets()
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.5))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    grads = {"w": jnp.ones_like(params["w"])}
+    cur, state = opt.step(state, grads)
+    opt.tx = optax.sgd(0.0)
+    state = jax.tree_util.tree_map(jnp.zeros_like, state)
+    before = np.asarray(win_mod.win_read(opt._name)).copy()
+    cur, state = opt.step(state, grads)
+    after = np.asarray(win_mod.win_read(opt._name))
+    # lr=0 inner update: the window exchange still averages, but with the
+    # uniform topology weights the fixed point is reached only through
+    # combine; the *inner step* contribution must be exactly zero — verify
+    # by comparing against a pure exchange of the same state.
+    # Simplest invariant: value stays within the convex hull of `before`
+    # (an lr=0.5 stale program would push it outside by the gradient).
+    assert after.min() >= before.min() - 1e-5
+    assert after.max() <= before.max() + 1e-5
+    opt.free()
+
+
+# -- in-place knob mutation --------------------------------------------------
+
+
+def test_mutated_weight_dict_takes_effect():
+    """r3-medium: mutating opt.dst_weights in place must not silently
+    reuse stale compiled weights."""
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.0))
+    x0 = np.zeros((SIZE, DIM), np.float32)
+    x0[0] = 100.0  # rank 0 carries the signal
+    params = {"w": bf.worker_values(list(x0))}
+    state = opt.init(params)
+    ctx = bf.get_context()
+    outs = ctx.out_neighbor_ranks()
+    dst = [{d: 0.0 for d in outs[r]} for r in range(SIZE)]
+    opt.dst_weights = dst
+    opt.self_weight = 1.0
+    grads = {"w": jnp.zeros_like(params["w"])}
+    recipient = outs[0][0]  # rank 0's single ring successor
+    cur, state = opt.step(state, grads)
+    # zero dst weight: the successor sees nothing of the 100
+    assert abs(np.asarray(cur["w"])[recipient, 0]) < 1e-5
+    # mutate IN PLACE: now rank 0 pushes full weight
+    dst[0][recipient] = 1.0
+    cur, state = opt.step(state, grads)
+    assert np.asarray(cur["w"])[recipient, 0] > 10.0  # the signal arrived
+    opt.free()
+
+
+# -- window-optimizer init validation ----------------------------------------
+
+
+def test_window_optimizer_rejects_bad_leading_axis():
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    bad = {"w": jnp.zeros((2 * SIZE, 3), jnp.float32)}  # divisible, wrong
+    with pytest.raises(ValueError, match="worker-stacked"):
+        opt.init(bad)
+
+
+def test_window_optimizer_rejects_integer_leaves():
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    bad = {
+        "w": jnp.zeros((SIZE, 3), jnp.float32),
+        "steps": jnp.zeros((SIZE,), jnp.int32),
+    }
+    with pytest.raises(TypeError, match="int"):
+        opt.init(bad)
