@@ -1,0 +1,127 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Scaling-efficiency evidence: static comm accounting + weak-scaling harness.
+
+TPU-native analogue of the reference's scaling story: the linear-speedup
+assertion script (``scripts/pytorch_opt_linear_speedup_test.py``) and the
+per-iteration comm-cost table (``README.rst:51-60``). Because the whole step
+is one compiled XLA program, per-step communication volume is *statically*
+verifiable from the optimized HLO — these tests pin the O(1)-in-N transfer
+claim that underlies the >95 % @128-worker efficiency number
+(``docs/performance.rst:26-53``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bluefog_tpu.topology as topo
+from bluefog_tpu import scaling
+from bluefog_tpu.collective import plan as planlib
+
+D = 4096  # payload elements per worker
+
+
+def one_peer_plan(n: int, step: int = 0) -> planlib.CommPlan:
+    """Static plan for one step of the dynamic one-peer Exp2 schedule."""
+    sched = planlib.schedule_from_dynamic(
+        n,
+        lambda r: topo.GetDynamicOnePeerSendRecvRanks(
+            topo.ExponentialGraph(n), r
+        ),
+    )
+    return sched.plans[step % sched.period]
+
+
+def test_one_peer_gossip_emits_one_collective_permute():
+    """One-peer gossip = exactly ONE collective-permute per step, any N."""
+    for n in (2, 4, 8):
+        stats = scaling.gossip_comm_stats(one_peer_plan(n), D)
+        cp = stats.get("collective-permute", {"count": 0, "bytes": 0})
+        assert cp["count"] == 1, (n, stats)
+        assert cp["bytes"] == D * 4, (n, stats)
+
+
+def test_one_peer_comm_volume_flat_in_n():
+    """Per-worker wire bytes do NOT grow with world size — the heart of the
+    reference cost table (README.rst:51-60 row 'Bluefog')."""
+    byte_counts = []
+    for n in (2, 4, 8):
+        stats = scaling.gossip_comm_stats(one_peer_plan(n), D)
+        byte_counts.append(
+            sum(v["bytes"] for v in stats.values())
+        )
+    assert byte_counts[0] == byte_counts[1] == byte_counts[2]
+
+
+def test_exp2_static_plan_rounds_are_log_n():
+    """The static Exp2 graph needs log2(N) ppermute rounds, not N-1."""
+    for n in (4, 8):
+        plan = planlib.plan_from_topology(
+            topo.ExponentialTwoGraph(n), weighted=True
+        )
+        stats = scaling.gossip_comm_stats(plan, D)
+        cp = stats["collective-permute"]
+        assert cp["count"] == int(np.log2(n)), (n, stats)
+
+
+def test_allreduce_lowered_to_all_reduce():
+    """The Horovod-baseline path emits an XLA all-reduce, whose ring cost
+    model is 2(N-1) hops / 2(N-1)/N payloads — the unfavorable side of the
+    comparison."""
+    plan = planlib.plan_from_topology(topo.ExponentialTwoGraph(8))
+    stats = scaling.gossip_comm_stats(plan, D, mode="allreduce")
+    assert stats.get("all-reduce", {"count": 0})["count"] >= 1
+    ring = scaling.ring_allreduce_cost(8, D * 4)
+    gossip = scaling.one_peer_gossip_cost(D * 4)
+    assert ring["latency_hops"] == 14 and gossip["latency_hops"] == 1
+    assert ring["wire_bytes"] > gossip["wire_bytes"]
+
+
+def test_neighbor_allreduce_beats_allreduce_in_hlo_collective_count():
+    """For one-peer schedules the compiled gossip program contains strictly
+    fewer collectives than the psum path's logical content at every N>2."""
+    n = 8
+    gossip_stats = scaling.gossip_comm_stats(one_peer_plan(n), D)
+    gossip_ops = sum(v["count"] for v in gossip_stats.values())
+    assert gossip_ops == 1
+
+
+def test_weak_scaling_harness_runs():
+    """The timing harness itself: constant per-worker batch, meshes of
+    1/2/4 devices, neighbor gossip in the step. On the CPU test platform the
+    efficiency numbers are not hardware claims — the assertion is only that
+    the harness produces sane, positive measurements in the right shape."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def make_step(mesh):
+        n = mesh.devices.size
+        plan = (
+            one_peer_plan(n)
+            if n > 1
+            else planlib.plan_from_topology(topo.FullyConnectedGraph(1))
+        )
+        spec = P("workers")
+
+        def body(x, w):
+            y = jnp.tanh(x @ w)
+            return scaling.inner.neighbor_allreduce(y, plan, "workers")
+
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(spec, P()), out_specs=spec,
+            )
+        )
+        x = jax.device_put(
+            np.ones((n, 8, 64), np.float32), NamedSharding(mesh, spec)
+        )
+        w = jnp.ones((64, 64), jnp.float32)
+        return fn, (x, w)
+
+    rows = scaling.weak_scaling_times(make_step, ns=(1, 2, 4), steps=3,
+                                      warmup=1)
+    assert [r["n"] for r in rows] == [1, 2, 4]
+    assert all(r["ms_per_step"] > 0 for r in rows)
+    assert all(r["efficiency"] > 0 for r in rows)

@@ -1,0 +1,89 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Dump the optimized HLO of the bench train step and summarize it.
+
+Prints convolution/dot op counts by operand dtype, fusion counts, and the
+largest ops — enough to spot f32 fallbacks and unfused elementwise chains
+without a TensorBoard profile.
+"""
+
+import os
+import re
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    import optax
+    from bluefog_tpu.models import ResNet50
+
+    batch = int(os.environ.get("PROBE_BATCH", "128"))
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.ones((batch, 224, 224, 3), jnp.bfloat16)
+    variables = model.init(rng, sample, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    def train_step(state, images, labels):
+        params, batch_stats, opt_state = state
+
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, new_stats, opt_state), loss
+
+    state = (params, batch_stats, opt_state)
+    lowered = jax.jit(train_step).lower(state, sample, labels)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+
+    conv_lines = [l for l in txt.splitlines() if "convolution(" in l or "convolution-base-dilated" in l]
+    dtype_counts = Counter()
+    for l in conv_lines:
+        m = re.match(r"\s*%?\S+\s*=\s*(\w+)\[", l)
+        if m:
+            dtype_counts[m.group(1)] += 1
+    print("convolutions by output dtype:", dict(dtype_counts))
+    print("total convolution ops:", len(conv_lines))
+    for kind in ("fusion(", "all-reduce(", "reduce(", "custom-call(",
+                 "transpose(", "copy(", "bitcast-convert("):
+        print(kind[:-1], txt.count(kind))
+    # f32 convolutions are the smoking gun for an MXU dtype fallback
+    f32_convs = [l.strip()[:160] for l in conv_lines if re.match(r"\s*%?\S+\s*=\s*f32\[", l)]
+    print("f32 convolutions:", len(f32_convs))
+    for l in f32_convs[:10]:
+        print("  ", l)
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    if ca:
+        print("cost analysis flops:", ca.get("flops"))
+        print("cost analysis bytes accessed:", ca.get("bytes accessed"))
+    out = os.environ.get("HLO_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write(txt)
+        print("wrote", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
