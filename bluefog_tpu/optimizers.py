@@ -80,6 +80,41 @@ def _tree_block(tree):
     return jax.tree_util.tree_map(lambda t: t[0], tree)
 
 
+def _packed_gossip(tree, gossip_fn, step, wops):
+    """Apply a gossip combine to a whole pytree with ONE wire payload per
+    dtype group per round.
+
+    XLA does not combine per-leaf collective-permutes (a 6-leaf ATC step
+    over a 3-round plan compiles to 18 of them — verified by
+    tests/test_fusion.py), so a model-sized tree would pay
+    O(leaves x rounds) message latencies. Packing every same-dtype leaf
+    into one flat vector before the combine is the TPU-native analogue of
+    the reference's tensor-fusion buffer (``tensor_queue.h:75-124``, 8 MiB
+    threshold, ``global_state.h:91``): the many-leaf gossip becomes a
+    single ppermute payload per round, at the price of one concat/split
+    (a fused HBM copy) per step. Grouping by dtype keeps the wire policy
+    intact — bf16 leaves gossip in bf16, never promoted by packing.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: dict = {}
+    for i, l in enumerate(leaves):
+        groups.setdefault(jnp.result_type(l), []).append(i)
+    out = [None] * len(leaves)
+    for _dt, idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = gossip_fn(leaves[i], step, wops)
+            continue
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        res = gossip_fn(flat, step, wops)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = res[off:off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _tree_restack(tree):
     return jax.tree_util.tree_map(lambda t: jnp.expand_dims(t, 0), tree)
 
@@ -326,22 +361,20 @@ class _GossipOptimizer:
                 if order == "grad":
                     # order='grad' only exists with allreduce communication
                     # (DistributedGradientAllreduceOptimizer)
-                    g = jax.tree_util.tree_map(
-                        lambda t: inner.allreduce(
+                    g = _packed_gossip(
+                        g,
+                        lambda t, _s, _w: inner.allreduce(
                             t, ctx_mod.WORKER_AXIS, average=True
                         ),
-                        g,
+                        step,
+                        wops,
                     )
                 if order == "cta":
-                    p = jax.tree_util.tree_map(
-                        lambda t: gossip_fn(t, step, wops), p
-                    )
+                    p = _packed_gossip(p, gossip_fn, step, wops)
                 updates, s = tx.update(g, s, p)
                 p = optax.apply_updates(p, updates)
                 if order == "atc":
-                    p = jax.tree_util.tree_map(
-                        lambda t: gossip_fn(t, step, wops), p
-                    )
+                    p = _packed_gossip(p, gossip_fn, step, wops)
                 return _tree_restack(p), _tree_restack(s)
 
             fn = jax.jit(
@@ -744,7 +777,18 @@ def DistributedPullGetOptimizer(base_optimizer):
 
 
 def DistributedPushSumOptimizer(base_optimizer):
-    """Push-sum (directed-graph) asynchronous SGD: column-stochastic
+    """Push-sum (directed-graph) asynchronous SGD: sender-stochastic
     win_accumulate of (x, p) with the x/p correction (reference :1180,
-    engine :1026-1177)."""
+    engine :1026-1177).
+
+    Iterate bookkeeping departs deliberately from the reference: this is
+    the textbook accumulated-p recursion (push raw x, never reset p),
+    where the reference pushes the corrected iterate and resets its
+    ps-weight to 1 every round. On weight-balanced digraphs (ring, Exp2 —
+    every uniform-weight regular graph) the two recursions are provably
+    identical step for step; on non-balanced digraphs they diverge at
+    step 2, and the accumulated-p form is the one that preserves
+    push-sum's exact-average guarantee. The committed numpy oracle for
+    both recursions, the sequence-equality proof, and the divergence pin
+    live in ``tests/test_pushsum_oracle.py``."""
     return _WindowOptimizer(base_optimizer, mode="push_sum")

@@ -57,11 +57,13 @@ _DTYPE_BYTES = {
 
 # `dtype[d0,d1,...]{layout} collective-permute(` — the result shape of the
 # instruction is its wire payload (one logical transfer per participating
-# device pair).
+# device pair). TPU compilation lowers collectives to async
+# `-start`/`-done` pairs; the `-start` carries the op and payload, so it is
+# counted and the `-done` is not.
 _COLLECTIVE_RE = re.compile(
     r"=\s*(?:\()?(\w+)\[([\d,]*)\][^=]*?\s"
     r"(collective-permute|all-reduce|all-gather|reduce-scatter|"
-    r"all-to-all)\("
+    r"all-to-all)(-start)?\("
 )
 
 

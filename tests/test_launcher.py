@@ -208,6 +208,8 @@ def test_default_nodes_per_machine():
 
 
 def test_maybe_init_distributed(monkeypatch):
+    """Argument-contract check only (env -> initialize kwargs); the real
+    two-process bring-up is proven end-to-end in test_multiprocess.py."""
     import jax
 
     from bluefog_tpu import context as ctx

@@ -274,3 +274,52 @@ def test_push_sum_optimizer_directed_ring():
     assert disagreement(cur) < 0.1
     opt.free()
     bf.turn_off_win_ops_with_associated_p()
+
+
+def test_hierarchical_optimizer_dynamic_machine_schedule(cpu_devices):
+    """The reference's dynamic-machine-Exp2 hierarchical training pattern
+    (GetExp2DynamicSendRecvMachineRanks driving hierarchical
+    neighbor_allreduce, ref examples/pytorch_benchmark.py:182-202) expressed
+    through the optimizer API: opt.schedule takes a MACHINE-level
+    SchedulePlan (4 machines x 2 local workers)."""
+    machines, local = 4, 2
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=local)
+    msched = schedule_from_dynamic(
+        machines,
+        lambda mr: tu.GetExp2DynamicSendRecvMachineRanks(
+            world_size=SIZE, local_size=local, self_rank=mr * local,
+            local_rank=0,
+        ),
+    )
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 10, 0.5))
+    )
+    opt.schedule = msched
+    c = targets()
+    params = make_params(c)
+    state = opt.init(params)
+    start = global_loss(params, c)
+    ctx = bf.get_context()
+    before = None
+    for i in range(60):
+        params, state = opt.step(params, state, quad_grads(params, c))
+        if i == 0:
+            before = len(ctx.op_cache)
+    assert len(ctx.op_cache) == before  # one compiled program, all steps
+    assert global_loss(params, c) < 0.05 * start
+    assert disagreement(params) < 0.1
+
+
+def test_hierarchical_schedule_must_be_machine_level(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=2)
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(optax.sgd(0.1))
+    opt.schedule = schedule_from_dynamic(
+        SIZE,
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialGraph(SIZE), r
+        ),
+    )  # worker-level (size 8) where machine-level (size 4) is required
+    params = make_params(targets())
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="machine-level"):
+        opt.step(params, state, quad_grads(params, targets()))

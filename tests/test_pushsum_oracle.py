@@ -1,0 +1,175 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Push-sum iterate oracle: numpy model of the reference recursion.
+
+The reference push-sum optimizer (``torch/optimizers.py:1026-1177``) runs,
+per iteration, with sender-stochastic weights W (W[i,j] = the share of
+rank i's mass sent to j; rows sum to 1; diagonal = self_weight):
+
+    zu_i(t)  = z_i(t) - lr * grad_i(z_i(t))          (inner SGD on iterate)
+    x_j(t+1) = sum_i W[i,j] * zu_i(t)                (win_accumulate+collect)
+    w_j(t+1) = sum_i W[i,j] * 1                      (ps-weight lane, RESET
+    z_j(t+1) = x_j(t+1) / w_j(t+1)                    to 1 every iteration)
+
+The TPU window-optimizer (``optimizers._WindowOptimizer`` mode='push_sum')
+keeps the textbook accumulated-p recursion instead:
+
+    u_i(t)   = x_i(t) - lr * grad_i  (grads evaluated at z = x/p by caller)
+    x_j(t+1) = sum_i W[i,j] * u_i(t)
+    p_j(t+1) = sum_i W[i,j] * p_i(t)                 (NEVER reset)
+    z_j(t+1) = x_j(t+1) / p_j(t+1)
+
+**Exact divergence point** (pinned below): on weight-balanced topologies
+(every column of W sums to 1 — all regular digraphs with uniform weights,
+e.g. a directed ring or Exp2) the two recursions are IDENTICAL: w stays 1,
+x stays the corrected iterate, so the reset is invisible. On non-balanced
+digraphs (e.g. a star) they agree at t=1 and diverge from t=2 on — and it
+is the reference's reset variant that loses push-sum's mass-conservation
+guarantee (its consensus limit is a skewed average on such graphs), while
+the accumulated-p recursion converges to the exact mean. The numpy models
+here are the committed oracle for both claims.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as tu
+
+SIZE = 8
+DIM = 3
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    bf.win_free()
+    bf.shutdown()
+
+
+def sender_stochastic_matrix(graph, size):
+    """W[i, j]: uniform split of rank i's mass over self + out-neighbors
+    (the reference's default dst_weights/self_weight, optimizers.py:1032)."""
+    w = np.zeros((size, size))
+    for i in range(size):
+        outs = [j for j in graph.successors(i) if j != i]
+        share = 1.0 / (len(outs) + 1)
+        w[i, i] = share
+        for j in outs:
+            w[i, j] = share
+    return w
+
+
+def reference_pushsum(z0, c, lr, steps, w):
+    """The reference recursion (corrected iterate, ps-weight reset)."""
+    z = z0.copy()
+    for _ in range(steps):
+        zu = z - lr * (z - c)
+        x = w.T @ zu
+        wsum = w.T @ np.ones(len(z0))
+        z = x / wsum[:, None]
+    return z
+
+
+def accumulated_pushsum(z0, c, lr, steps, w):
+    """The TPU window-optimizer recursion (raw x, accumulated p)."""
+    x = z0.copy()
+    p = np.ones(len(z0))
+    z = x / p[:, None]
+    out = []
+    for _ in range(steps):
+        u = x - lr * (z - c)  # grads evaluated at the corrected estimate
+        x = w.T @ u
+        p = w.T @ p
+        z = x / p[:, None]
+        out.append(z.copy())
+    return np.asarray(out)
+
+
+def run_window_optimizer(graph, z0, c, lr, steps):
+    bf.set_topology(graph)
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(lr))
+    params = {"w": bf.worker_values(lambda r: z0[r])}
+    state = opt.init(params)
+    seq = []
+    for _ in range(steps):
+        est = opt.params()
+        grads = {"w": est["w"] - jnp.asarray(c)}
+        _, state = opt.step(state, grads)
+        seq.append(np.asarray(opt.params()["w"]))
+    opt.free()
+    return np.asarray(seq)
+
+
+def problem(seed=0):
+    rng = np.random.RandomState(seed)
+    z0 = rng.randn(SIZE, DIM).astype(np.float32)
+    c = z0.copy()  # pure-local optimum: only communication creates motion
+    return z0, c
+
+
+def test_ring_iterate_sequence_matches_reference_oracle():
+    """On a directed ring (weight-balanced) the window optimizer's iterate
+    sequence equals the reference recursion step for step."""
+    z0, c = problem()
+    graph = tu.RingGraph(SIZE, connect_style=1)  # directed one-way ring
+    w = sender_stochastic_matrix(graph, SIZE)
+    assert np.allclose(w.sum(1), 1.0) and np.allclose(w.sum(0), 1.0)
+    got = run_window_optimizer(graph, z0, c, lr=0.2, steps=12)
+    z = z0.copy()
+    for t in range(12):
+        z = reference_pushsum(z, c, 0.2, 1, w)
+        np.testing.assert_allclose(got[t], z, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"diverged at step {t}")
+
+
+def test_ring_consensus_reaches_exact_mean():
+    z0, c = problem()
+    graph = tu.RingGraph(SIZE, connect_style=1)
+    got = run_window_optimizer(graph, z0, c, lr=0.0, steps=200)
+    np.testing.assert_allclose(
+        got[-1], np.tile(z0.mean(0), (SIZE, 1)), atol=1e-3
+    )
+
+
+def test_star_divergence_point_is_step_two():
+    """Non-balanced digraph: the recursions agree at t=1, split at t=2
+    (the reference resets w to 1 after its first collect; the accumulated-p
+    lane keeps mass). This is the documented iterate-bookkeeping departure
+    (optimizers.py DistributedPushSumOptimizer docstring)."""
+    z0, c = problem(1)
+    graph = tu.StarGraph(SIZE)
+    w = sender_stochastic_matrix(graph, SIZE)
+    assert not np.allclose(w.sum(0), 1.0)  # star is not weight-balanced
+    got = run_window_optimizer(graph, z0, c, lr=0.0, steps=2)
+    oracle_acc = accumulated_pushsum(z0, c, 0.0, 2, w)
+    # our implementation IS the accumulated-p oracle on any graph
+    np.testing.assert_allclose(got, oracle_acc, rtol=1e-4, atol=1e-5)
+    # vs the reference recursion: equal at t=1 ...
+    ref1 = reference_pushsum(z0, c, 0.0, 1, w)
+    np.testing.assert_allclose(got[0], ref1, rtol=1e-4, atol=1e-5)
+    # ... diverged at t=2
+    ref2 = reference_pushsum(z0, c, 0.0, 2, w)
+    assert np.abs(got[1] - ref2).max() > 1e-3
+
+
+def test_star_accumulated_p_preserves_exact_mean():
+    """What the departure buys: on the star the accumulated-p recursion
+    still converges to the exact average; the reference's reset recursion
+    settles on a skewed consensus (center over-weighted)."""
+    z0, c = problem(2)
+    graph = tu.StarGraph(SIZE)
+    w = sender_stochastic_matrix(graph, SIZE)
+    got = run_window_optimizer(graph, z0, c, lr=0.0, steps=120)
+    np.testing.assert_allclose(
+        got[-1], np.tile(z0.mean(0), (SIZE, 1)), atol=1e-3
+    )
+    ref = z0.copy()
+    for _ in range(120):
+        ref = reference_pushsum(ref, c, 0.0, 1, w)
+    # reference limit is a consensus, but NOT the mean
+    assert np.abs(ref - ref.mean(0)).max() < 1e-3
+    assert np.abs(ref.mean(0) - z0.mean(0)).max() > 1e-2

@@ -125,3 +125,17 @@ def test_weak_scaling_harness_runs():
     assert [r["n"] for r in rows] == [1, 2, 4]
     assert all(r["ms_per_step"] > 0 for r in rows)
     assert all(r["efficiency"] > 0 for r in rows)
+
+
+def test_hlo_stats_counts_async_start_forms():
+    """TPU compilation lowers collectives to -start/-done pairs; the -start
+    carries the payload and must be counted once (the -done must not)."""
+    txt = """
+  %cp = (f32[100]{0}, f32[100]{0}) collective-permute-start(%x), source_target_pairs={{0,1}}
+  %cpd = f32[100]{0} collective-permute-done(%cp)
+  %ar = bf16[32]{0} all-reduce-start(%y), to_apply=%add
+  %ard = bf16[32]{0} all-reduce-done(%ar)
+"""
+    stats = scaling.hlo_collective_stats(txt)
+    assert stats["collective-permute"] == {"count": 1, "bytes": 400}, stats
+    assert stats["all-reduce"] == {"count": 1, "bytes": 64}, stats

@@ -50,3 +50,55 @@ def test_broadcast_optimizer_state():
         )
     ).T:
         assert np.allclose(leaf, leaf[0])
+
+
+def test_tree_helpers_single_dispatch():
+    """The whole pytree goes through ONE compiled program (the reference
+    relies on its fusion buffer for this; an eager per-leaf loop would be
+    ~160 serialized dispatches on a ResNet50-sized tree)."""
+    ctx = bf.get_context()
+    params = {
+        f"w{i}": bf.worker_values(lambda r: np.full((4,), float(r), np.float32))
+        for i in range(12)
+    }
+    before = len(ctx.op_cache)
+    out = bf.broadcast_parameters(params, root_rank=1)
+    assert len(ctx.op_cache) == before + 1  # one entry for a 12-leaf tree
+    bf.broadcast_parameters(params, root_rank=1)
+    assert len(ctx.op_cache) == before + 1  # cached on repeat
+    for leaf in out.values():
+        np.testing.assert_allclose(np.asarray(leaf), 1.0)
+    before = len(ctx.op_cache)
+    bf.allreduce_parameters(params)
+    assert len(ctx.op_cache) == before + 1
+
+
+def test_tree_helpers_reject_unstacked_leaf():
+    with pytest.raises(ValueError):
+        bf.broadcast_parameters({"w": np.ones((SIZE + 1, 2), np.float32)})
+
+
+def test_broadcast_rejects_out_of_range_root():
+    """mask-and-psum with a never-matching root would silently zero every
+    parameter; it must raise instead."""
+    params = {"w": bf.worker_values(lambda r: np.ones((2,), np.float32))}
+    with pytest.raises(ValueError, match="root_rank"):
+        bf.broadcast_parameters(params, root_rank=SIZE)
+    with pytest.raises(ValueError, match="root_rank"):
+        bf.broadcast_optimizer_state(params, root_rank=-1)
+
+
+def test_tree_helpers_record_timeline_spans(tmp_path):
+    """Tree ops must appear in BLUEFOG_TIMELINE traces like any other
+    eager dispatch."""
+    import json
+
+    path = str(tmp_path / "trace.json")
+    assert bf.timeline_init(path)
+    try:
+        params = {"w": bf.worker_values(lambda r: np.ones((2,), np.float32))}
+        bf.broadcast_parameters(params)
+    finally:
+        assert bf.timeline_shutdown()
+    events = json.load(open(path))
+    assert any(e.get("name") == "tree_broadcast" for e in events), events
