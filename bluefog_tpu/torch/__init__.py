@@ -1,0 +1,52 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""bluefog_tpu.torch: a PyTorch-tensor frontend over the TPU-native runtime.
+
+The reference ships a second, thinner frontend next to its primary one
+(``bluefog/tensorflow``: mpi_ops with registered gradients + a
+gradient-allreduce ``DistributedOptimizer`` + ``broadcast_variables``,
+~500 LoC over the same C core). TensorFlow is not part of the TPU stack,
+so the second frontend here serves the framework users actually pair with
+JAX: **PyTorch**. Same design point as the reference's TF layer — a thin
+adapter over the one runtime, not a second runtime:
+
+- ops take/return ``torch.Tensor`` worker arrays (leading axis = worker)
+  and execute on the JAX mesh (the compiled ppermute/psum programs of
+  :mod:`bluefog_tpu.collective`);
+- ``allreduce`` / ``broadcast`` / ``neighbor_allreduce`` are
+  differentiable through ``torch.autograd`` (the analogue of the TF
+  frontend's registered gradients): backward re-enters the mesh with the
+  adjoint combine (transposed weight matrix);
+- optimizer wrappers splice the same communication around any
+  ``torch.optim.Optimizer``.
+
+bfloat16 tensors cross the boundary bit-exactly (uint16 view ↔
+``ml_dtypes.bfloat16``), so the TPU wire dtype policy is preserved.
+"""
+
+from bluefog_tpu.torch.mpi_ops import (
+    allreduce,
+    allgather,
+    broadcast,
+    neighbor_allreduce,
+    neighbor_allgather,
+    to_numpy,
+    from_numpy,
+)
+from bluefog_tpu.torch.optimizers import (
+    DistributedGradientAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    broadcast_parameters,
+)
+
+__all__ = [
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "neighbor_allreduce",
+    "neighbor_allgather",
+    "to_numpy",
+    "from_numpy",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+    "broadcast_parameters",
+]

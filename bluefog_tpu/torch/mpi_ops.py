@@ -1,0 +1,155 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Torch-tensor collective ops over the JAX mesh runtime.
+
+Mirrors the reference second-frontend op surface
+(``bluefog/tensorflow/mpi_ops.py``: allreduce/allgather/broadcast/
+neighbor_allreduce/neighbor_allgather with registered gradients) for
+PyTorch tensors. Tensors are worker arrays (leading axis = worker); the
+compute path is the compiled SPMD programs of
+:mod:`bluefog_tpu.collective.ops` — this module only converts at the
+boundary and wires ``torch.autograd`` adjoints.
+"""
+
+from typing import List
+
+import numpy as np
+import torch
+
+import ml_dtypes
+
+from bluefog_tpu import context as ctx_mod
+from bluefog_tpu.collective import ops as col_ops
+
+
+def to_numpy(t: torch.Tensor) -> np.ndarray:
+    """Torch -> numpy, bit-exact for bfloat16 (numpy itself has no bf16;
+    the bits travel as uint16 and are re-viewed as ml_dtypes.bfloat16,
+    which JAX understands natively)."""
+    t = t.detach().contiguous().cpu()
+    if t.dtype == torch.bfloat16:
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def from_numpy(a) -> torch.Tensor:
+    """JAX/numpy -> torch, bit-exact for bfloat16."""
+    a = np.array(a)  # materialize + make writable (torch requires it)
+    if a.dtype == ml_dtypes.bfloat16:
+        return torch.from_numpy(a.view(np.uint16)).view(torch.bfloat16)
+    return torch.from_numpy(a)
+
+
+class _Allreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, t, average):
+        ctx.average = average
+        return from_numpy(col_ops.allreduce(to_numpy(t), average=average))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # y_j = (1/n) sum_i x_i (or sum): d/dx_i = same reduction of the
+        # incoming grads — the TF frontend registers exactly this adjoint.
+        g = from_numpy(
+            col_ops.allreduce(to_numpy(grad), average=ctx.average)
+        )
+        return g, None
+
+
+def allreduce(t: torch.Tensor, average: bool = True) -> torch.Tensor:
+    """Global mean (or sum) across workers; differentiable."""
+    return _Allreduce.apply(t, average)
+
+
+class _Broadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, t, root_rank):
+        ctx.root_rank = root_rank
+        return from_numpy(col_ops.broadcast(to_numpy(t), root_rank))
+
+    @staticmethod
+    def backward(ctx, grad):
+        # every slot's grad flows back to the root slot (reduce-to-root)
+        summed = np.asarray(col_ops.allreduce(to_numpy(grad), average=False))
+        g = np.zeros_like(summed)
+        g[ctx.root_rank] = summed[ctx.root_rank]
+        return from_numpy(g), None
+
+
+def broadcast(t: torch.Tensor, root_rank: int) -> torch.Tensor:
+    """Every worker slot becomes the root's value; differentiable."""
+    return _Broadcast.apply(t, root_rank)
+
+
+class _NeighborAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, t, self_weight, src_weights, dst_weights,
+                enable_topo_check):
+        rt_ctx = ctx_mod.get_context()
+        # Resolve once so backward can transpose the same weights even if
+        # the context topology changes between forward and backward; the
+        # frozen plan is cheap to hold (the dense matrix is built only if
+        # backward actually runs).
+        ctx.plan = col_ops._resolve_plan(
+            rt_ctx, self_weight, src_weights, dst_weights, enable_topo_check
+        )
+        # Public op path: worker-array validation + compiled dispatch +
+        # timeline span, identical to the JAX facade.
+        return from_numpy(
+            col_ops.neighbor_allreduce(
+                to_numpy(t),
+                self_weight=self_weight,
+                src_weights=src_weights,
+                dst_weights=dst_weights,
+                enable_topo_check=enable_topo_check,
+            )
+        )
+
+    @staticmethod
+    def backward(ctx, grad):
+        # forward is y = W^T x (rows = workers); adjoint is W g — a
+        # combine with the transposed weight matrix, run on the mesh too.
+        w_t = ctx.plan.weight_matrix().T
+        self_w = [float(w_t[j, j]) for j in range(w_t.shape[0])]
+        src = [
+            {int(i): float(w_t[i, j]) for i in np.nonzero(w_t[:, j])[0]
+             if i != j}
+            for j in range(w_t.shape[0])
+        ]
+        g = col_ops.neighbor_allreduce(
+            to_numpy(grad),
+            self_weight=self_w,
+            src_weights=src,
+            # adjoint edges are the forward edges reversed; skip the
+            # in-neighbor containment check against the *current* topology
+            dst_weights=[list(np.nonzero(w_t[j, :])[0][
+                np.nonzero(w_t[j, :])[0] != j]) for j in range(w_t.shape[0])],
+            enable_topo_check=False,
+        )
+        return from_numpy(g), None, None, None, None
+
+
+def neighbor_allreduce(
+    t: torch.Tensor,
+    *,
+    self_weight=None,
+    src_weights=None,
+    dst_weights=None,
+    enable_topo_check: bool = True,
+) -> torch.Tensor:
+    """Weighted neighbor combine per the active (or explicit) topology;
+    differentiable (adjoint = transposed-weight combine)."""
+    return _NeighborAllreduce.apply(
+        t, self_weight, src_weights, dst_weights, enable_topo_check
+    )
+
+
+def allgather(t: torch.Tensor) -> torch.Tensor:
+    """Concatenate every worker's slot along dim 0 (not differentiable,
+    matching the reference TF frontend's grad-less allgather)."""
+    return from_numpy(col_ops.allgather(to_numpy(t)))
+
+
+def neighbor_allgather(t: torch.Tensor) -> List[torch.Tensor]:
+    """Raw in-neighbor values per rank, rank-ascending; entry ``r`` has
+    shape ``[in_degree_r, ...]``."""
+    return [from_numpy(v) for v in col_ops.neighbor_allgather(to_numpy(t))]
