@@ -21,10 +21,25 @@ from bluefog_tpu import context as ctx_mod
 from bluefog_tpu.collective import ops as col_ops
 
 
+_64BIT = (torch.int64, torch.float64, torch.complex128)
+
+
 def to_numpy(t: torch.Tensor) -> np.ndarray:
     """Torch -> numpy, bit-exact for bfloat16 (numpy itself has no bf16;
     the bits travel as uint16 and are re-viewed as ml_dtypes.bfloat16,
-    which JAX understands natively)."""
+    which JAX understands natively). 64-bit dtypes are rejected: the mesh
+    computes in 32-bit (jax x64 disabled), so an int64 step counter or
+    f64 parameter would be silently truncated and written back corrupted."""
+    if t.dtype in _64BIT:
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            raise TypeError(
+                f"{t.dtype} tensors cannot cross the torch<->mesh boundary: "
+                "JAX computes in 32-bit here, so the values would be "
+                "silently truncated. Cast to a 32-bit dtype first (or "
+                "enable jax_enable_x64)."
+            )
     t = t.detach().contiguous().cpu()
     if t.dtype == torch.bfloat16:
         return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
@@ -80,51 +95,45 @@ def broadcast(t: torch.Tensor, root_rank: int) -> torch.Tensor:
     return _Broadcast.apply(t, root_rank)
 
 
+def _combine_with_plan(np_arr: np.ndarray, plan):
+    """Validated, timeline-instrumented combine over an explicit plan
+    (one plan resolution; forward and backward share this path)."""
+    rt_ctx = ctx_mod.get_context()
+    arr = col_ops._check_worker_array(rt_ctx, np_arr)
+    fn = col_ops._compiled(
+        rt_ctx,
+        "neighbor_allreduce",
+        (plan,) + col_ops._aval_key(arr),
+        lambda xb: col_ops.inner.neighbor_allreduce(
+            xb, plan, ctx_mod.WORKER_AXIS
+        ),
+        in_specs=col_ops.P(ctx_mod.WORKER_AXIS),
+        out_specs=col_ops.P(ctx_mod.WORKER_AXIS),
+    )
+    return fn(arr)
+
+
 class _NeighborAllreduce(torch.autograd.Function):
     @staticmethod
     def forward(ctx, t, self_weight, src_weights, dst_weights,
                 enable_topo_check):
         rt_ctx = ctx_mod.get_context()
-        # Resolve once so backward can transpose the same weights even if
-        # the context topology changes between forward and backward; the
-        # frozen plan is cheap to hold (the dense matrix is built only if
-        # backward actually runs).
+        # Resolve once; backward transposes the same weights even if the
+        # context topology changes between forward and backward. The dense
+        # matrix is only built if backward actually runs.
         ctx.plan = col_ops._resolve_plan(
             rt_ctx, self_weight, src_weights, dst_weights, enable_topo_check
         )
-        # Public op path: worker-array validation + compiled dispatch +
-        # timeline span, identical to the JAX facade.
-        return from_numpy(
-            col_ops.neighbor_allreduce(
-                to_numpy(t),
-                self_weight=self_weight,
-                src_weights=src_weights,
-                dst_weights=dst_weights,
-                enable_topo_check=enable_topo_check,
-            )
-        )
+        return from_numpy(_combine_with_plan(to_numpy(t), ctx.plan))
 
     @staticmethod
     def backward(ctx, grad):
         # forward is y = W^T x (rows = workers); adjoint is W g — a
         # combine with the transposed weight matrix, run on the mesh too.
-        w_t = ctx.plan.weight_matrix().T
-        self_w = [float(w_t[j, j]) for j in range(w_t.shape[0])]
-        src = [
-            {int(i): float(w_t[i, j]) for i in np.nonzero(w_t[:, j])[0]
-             if i != j}
-            for j in range(w_t.shape[0])
-        ]
-        g = col_ops.neighbor_allreduce(
-            to_numpy(grad),
-            self_weight=self_w,
-            src_weights=src,
-            # adjoint edges are the forward edges reversed; skip the
-            # in-neighbor containment check against the *current* topology
-            dst_weights=[list(np.nonzero(w_t[j, :])[0][
-                np.nonzero(w_t[j, :])[0] != j]) for j in range(w_t.shape[0])],
-            enable_topo_check=False,
-        )
+        from bluefog_tpu.collective.plan import plan_from_matrix
+
+        plan_t = plan_from_matrix(ctx.plan.weight_matrix().T)
+        g = _combine_with_plan(to_numpy(grad), plan_t)
         return from_numpy(g), None, None, None, None
 
 

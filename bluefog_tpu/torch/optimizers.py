@@ -49,10 +49,12 @@ def _specialize(optimizer: torch.optim.Optimizer, name: str, communicate):
         return base.step(self, closure)
 
     def add_param_group(self, group):
-        out = base.add_param_group(self, group)
-        for p in group["params"]:
+        # validate BEFORE registration: raising after base.add_param_group
+        # would leave the invalid group installed
+        params = group["params"]
+        for p in ([params] if isinstance(params, torch.Tensor) else params):
             _check_stacked(p)
-        return out
+        return base.add_param_group(self, group)
 
     cls = type(name, (base,), {"step": step,
                                "add_param_group": add_param_group})

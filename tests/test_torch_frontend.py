@@ -266,3 +266,24 @@ def test_broadcast_parameters_skips_non_tensor_dict_values():
     bft.broadcast_parameters(params, root_rank=0)
     torch.testing.assert_close(params["w"][3], ref)
     assert params["meta"] == {"nested": "state"}
+
+
+def test_64bit_dtypes_rejected_not_truncated():
+    """int64/float64 would be silently truncated by the 32-bit mesh; the
+    boundary must refuse instead of corrupting values in place."""
+    big = torch.full((SIZE, 2), 2**40, dtype=torch.int64)
+    with pytest.raises(TypeError, match="truncated"):
+        bft.allreduce(big)
+    with pytest.raises(TypeError, match="truncated"):
+        bft.broadcast_parameters([big])
+    assert big[0, 0].item() == 2**40  # untouched
+
+
+def test_add_param_group_failure_leaves_optimizer_clean():
+    c, p = quad_problem(7)
+    opt = bft.DistributedGradientAllreduceOptimizer(
+        torch.optim.SGD([p], lr=0.1)
+    )
+    with pytest.raises(ValueError, match="worker-stacked"):
+        opt.add_param_group({"params": [torch.nn.Parameter(torch.ones(3))]})
+    assert len(opt.param_groups) == 1  # invalid group NOT installed
