@@ -17,6 +17,7 @@ from bluefog_tpu.ops.attention import (
     ulysses_attention,
     reference_attention,
 )
+from bluefog_tpu.ops.flash import flash_attention, flash_attention_supported
 
 __all__ = [
     "ring_attention_block",
@@ -24,4 +25,6 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "reference_attention",
+    "flash_attention",
+    "flash_attention_supported",
 ]

@@ -101,8 +101,7 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
     m0 = _vary(jnp.full((b, h, t), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
 
-    def round_fn(r, carry):
-        kcur, vcur, acc, m, l = carry
+    def attend(r, kcur, vcur, acc, m, l):
         src = (my - r) % n  # whose K/V block this worker holds this round
         s = _block_scores(q, kcur, scale).astype(jnp.float32)  # [b,h,t,t]
         if causal:
@@ -123,13 +122,22 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
             acc * corr.transpose(0, 2, 1)[..., None]
             + jnp.einsum("bhqk,bkhd->bqhd", p, vcur.astype(jnp.float32))
         )
+        return acc, m_new, l
+
+    def round_fn(r, carry):
+        kcur, vcur, acc, m, l = carry
+        acc, m, l = attend(r, kcur, vcur, acc, m, l)
         kcur = lax.ppermute(kcur, axis_name, perm)
         vcur = lax.ppermute(vcur, axis_name, perm)
-        return kcur, vcur, acc, m_new, l
+        return kcur, vcur, acc, m, l
 
-    _, _, acc, m, l = lax.fori_loop(
-        0, n, round_fn, (k, v, acc0, m0, l0)
+    # n-1 (attend, rotate) rounds, then a final attend with NO rotation:
+    # the last permute's result would be discarded, and inside the loop
+    # XLA cannot DCE a collective — at n=2 it would double the traffic.
+    kcur, vcur, acc, m, l = lax.fori_loop(
+        0, n - 1, round_fn, (k, v, acc0, m0, l0)
     )
+    acc, m, l = attend(n - 1, kcur, vcur, acc, m, l)
     lsafe = jnp.where(l > 0, l, 1.0)
     out = acc / lsafe.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -146,9 +154,11 @@ def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,
     """
     n = lax.psum(1, axis_name)
     h = q.shape[2]
-    assert h % n == 0, (
-        f"ulysses attention needs heads ({h}) divisible by mesh size ({n})"
-    )
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({h}) divisible by mesh "
+            f"size ({n})"
+        )
 
     def seq_to_heads(x):
         # [b, t, h, d] -> concat seq, split heads -> [b, t*n, h/n, d]
@@ -162,7 +172,11 @@ def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,
         )
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = reference_attention(qf, kf, vf, causal=causal, scale=scale)
+    # local attention hot op: Pallas flash kernel on TPU when the tiling
+    # allows, dense XLA otherwise (same math; see ops/flash.py)
+    from bluefog_tpu.ops.flash import flash_attention
+
+    out = flash_attention(qf, kf, vf, causal=causal, scale=scale)
     return heads_to_seq(out)
 
 

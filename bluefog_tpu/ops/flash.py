@@ -1,0 +1,157 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Pallas flash-attention kernel for the local attention hot op.
+
+The sequence-parallel layers (:mod:`bluefog_tpu.ops.attention`) delegate
+their per-device block attention to XLA by default; this module provides
+the hand-tiled TPU kernel for the same math — flash-attention online
+softmax with one pass over K/V tiles, f32 accumulators in VMEM, causal
+tiles skipped entirely (not just masked) so the causal kernel does half
+the work. Layout follows the MXU/VPU tiling rules: Q/K/V tiles are
+``[block, head_dim]`` with ``head_dim`` and blocks multiples of 128 lanes
+/ 8 sublanes (``pallas_guide``: tiling constraints).
+
+``flash_attention`` falls back to the dense XLA path off-TPU or for
+shapes the tiling cannot cover, so callers can use it unconditionally.
+``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
+"""
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_attention", "flash_attention_supported"]
+
+_LANES = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+            *, scale, causal, block_q, block_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _tile():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(-1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(
+            jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0
+        )
+        l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr[:, None] + pv
+        m_ref[:, 0] = m_new
+
+    if causal:
+        # skip K tiles that lie entirely in the future of this Q tile
+        pl.when(ik * block_k < (iq + 1) * block_q)(_tile)
+    else:
+        _tile()
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_supported(q, block_q: int = 128,
+                              block_k: int = 128) -> bool:
+    """Tiling feasibility: seq divisible by the blocks, head_dim a lane
+    multiple."""
+    _b, t, _h, d = q.shape
+    return (
+        t % block_q == 0 and t % block_k == 0 and d % _LANES == 0
+        and t >= max(block_q, block_k)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (b * h, t // block_q, t // block_k)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Flash attention on ``[batch, seq, heads, head_dim]`` tensors.
+
+    Uses the Pallas TPU kernel when the platform and tiling allow;
+    otherwise falls back to the dense XLA attention (same math)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (
+        pltpu is None
+        or not flash_attention_supported(q, block_q, block_k)
+        or not (on_tpu or interpret)
+    ):
+        from bluefog_tpu.ops.attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, causal, float(scale), block_q, block_k,
+                  interpret)

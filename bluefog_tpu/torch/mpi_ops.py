@@ -21,25 +21,36 @@ from bluefog_tpu import context as ctx_mod
 from bluefog_tpu.collective import ops as col_ops
 
 
-_64BIT = (torch.int64, torch.float64, torch.complex128)
-
-
 def to_numpy(t: torch.Tensor) -> np.ndarray:
     """Torch -> numpy, bit-exact for bfloat16 (numpy itself has no bf16;
     the bits travel as uint16 and are re-viewed as ml_dtypes.bfloat16,
-    which JAX understands natively). 64-bit dtypes are rejected: the mesh
-    computes in 32-bit (jax x64 disabled), so an int64 step counter or
-    f64 parameter would be silently truncated and written back corrupted."""
-    if t.dtype in _64BIT:
-        import jax
+    which JAX understands natively).
 
-        if not jax.config.jax_enable_x64:
+    The mesh computes in 32-bit (jax x64 disabled), so 64-bit inputs
+    cannot pass through unchanged. int64 tensors whose VALUES fit int32
+    (the common case: step counters, BatchNorm ``num_batches_tracked``)
+    are narrowed losslessly; out-of-range int64 and float64 (silent
+    precision loss) are rejected rather than corrupted."""
+    import jax
+
+    x64 = jax.config.jax_enable_x64
+    if t.dtype == torch.int64 and not x64:
+        if t.numel() and (
+            t.max().item() > 2**31 - 1 or t.min().item() < -(2**31)
+        ):
             raise TypeError(
-                f"{t.dtype} tensors cannot cross the torch<->mesh boundary: "
-                "JAX computes in 32-bit here, so the values would be "
-                "silently truncated. Cast to a 32-bit dtype first (or "
-                "enable jax_enable_x64)."
+                "int64 tensor has values outside int32 range: the 32-bit "
+                "mesh would silently wrap them. Keep such state out of "
+                "the distributed tree (or enable jax_enable_x64)."
             )
+        t = t.to(torch.int32)
+    elif t.dtype in (torch.float64, torch.complex128) and not x64:
+        raise TypeError(
+            f"{t.dtype} tensors cannot cross the torch<->mesh boundary: "
+            "JAX computes in 32-bit here, so precision would be silently "
+            "lost. Cast to a 32-bit dtype first (or enable "
+            "jax_enable_x64)."
+        )
     t = t.detach().contiguous().cpu()
     if t.dtype == torch.bfloat16:
         return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)

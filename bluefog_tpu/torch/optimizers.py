@@ -49,10 +49,14 @@ def _specialize(optimizer: torch.optim.Optimizer, name: str, communicate):
         return base.step(self, closure)
 
     def add_param_group(self, group):
-        # validate BEFORE registration: raising after base.add_param_group
-        # would leave the invalid group installed
+        # materialize (params is commonly a generator — iterating it for
+        # validation must not leave the base class an exhausted iterator),
+        # then validate BEFORE registration: raising after
+        # base.add_param_group would leave the invalid group installed
         params = group["params"]
-        for p in ([params] if isinstance(params, torch.Tensor) else params):
+        params = [params] if isinstance(params, torch.Tensor) else list(params)
+        group["params"] = params
+        for p in params:
             _check_stacked(p)
         return base.add_param_group(self, group)
 

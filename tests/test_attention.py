@@ -140,11 +140,11 @@ def test_ring_attention_comm_volume_one_block_per_round():
     txt = fn.lower(*args).compile().as_text()
     stats = scaling.hlo_collective_stats(txt)
     cp = stats.get("collective-permute", {"count": 0, "bytes": 0})
-    # the loop body contains the K and V rotation; XLA may unroll or keep
-    # the loop — either way the per-round payload is 2 blocks
-    assert cp["count"] in (2, 2 * SIZE), stats
+    # n-1 rotations (the final round attends without rotating — a last
+    # permute would be dead traffic); XLA may unroll or keep the loop
+    assert cp["count"] in (2, 2 * (SIZE - 1)), stats
     block_bytes = B * T * H * D * 4
-    assert cp["bytes"] in (2 * block_bytes, 2 * SIZE * block_bytes), stats
+    assert cp["bytes"] in (2 * block_bytes, 2 * (SIZE - 1) * block_bytes), stats
 
 
 def test_ulysses_requires_divisible_heads():
@@ -152,7 +152,7 @@ def test_ulysses_requires_divisible_heads():
     spec = P("workers")
     bad_h = SIZE - 1  # not divisible
     q = jnp.zeros((SIZE, B, T, bad_h, D))
-    with pytest.raises(AssertionError, match="divisible"):
+    with pytest.raises(ValueError, match="divisible"):
         jax.shard_map(
             lambda q, k, v: ulysses_attention_block(
                 q[0], k[0], v[0], "workers"

@@ -1,0 +1,69 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Pallas flash-attention kernel vs dense reference.
+
+The kernel runs in the Pallas interpreter here (CPU CI); the identical
+kernel compiles to Mosaic on a real TPU (correctness re-verified on-chip,
+errors at bf16 rounding level — see docs/attention.md).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu.ops.attention import reference_attention
+from bluefog_tpu.ops.flash import flash_attention, flash_attention_supported
+
+B, T, H, D = 2, 256, 2, 128
+
+
+def qkv(seed=0, t=T):
+    rng = np.random.RandomState(seed)
+    return [
+        jnp.asarray(rng.randn(B, t, H, D), jnp.float32) for _ in range(3)
+    ]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_dense(causal):
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_blocks_tile_the_sequence():
+    q, k, v = qkv(1)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=128, interpret=True
+    )
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_support_predicate_and_fallback():
+    q, k, v = qkv()
+    assert flash_attention_supported(q)
+    assert not flash_attention_supported(jnp.zeros((1, 100, 2, 128)))
+    assert not flash_attention_supported(jnp.zeros((1, 256, 2, 96)))
+    # unsupported shapes fall back to the dense path, same semantics
+    qs = jnp.asarray(np.random.RandomState(2).randn(1, 100, 2, 96),
+                     jnp.float32)
+    out = flash_attention(qs, qs, qs, causal=True)
+    ref = reference_attention(qs, qs, qs, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_scale_override():
+    q, k, v = qkv(3)
+    out = flash_attention(q, k, v, scale=0.5, interpret=True)
+    ref = reference_attention(q, k, v, scale=0.5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )

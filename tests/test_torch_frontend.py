@@ -269,14 +269,17 @@ def test_broadcast_parameters_skips_non_tensor_dict_values():
 
 
 def test_64bit_dtypes_rejected_not_truncated():
-    """int64/float64 would be silently truncated by the 32-bit mesh; the
-    boundary must refuse instead of corrupting values in place."""
+    """Out-of-range int64 and all float64 would be silently corrupted by
+    the 32-bit mesh; the boundary must refuse instead (in-range int64
+    narrows losslessly — see test_int64_in_range_narrows_losslessly)."""
     big = torch.full((SIZE, 2), 2**40, dtype=torch.int64)
-    with pytest.raises(TypeError, match="truncated"):
+    with pytest.raises(TypeError, match="int32 range"):
         bft.allreduce(big)
-    with pytest.raises(TypeError, match="truncated"):
+    with pytest.raises(TypeError, match="int32 range"):
         bft.broadcast_parameters([big])
     assert big[0, 0].item() == 2**40  # untouched
+    with pytest.raises(TypeError, match="precision"):
+        bft.allreduce(torch.randn(SIZE, 2, dtype=torch.float64))
 
 
 def test_add_param_group_failure_leaves_optimizer_clean():
@@ -287,3 +290,24 @@ def test_add_param_group_failure_leaves_optimizer_clean():
     with pytest.raises(ValueError, match="worker-stacked"):
         opt.add_param_group({"params": [torch.nn.Parameter(torch.ones(3))]})
     assert len(opt.param_groups) == 1  # invalid group NOT installed
+
+
+def test_int64_in_range_narrows_losslessly():
+    """Small-valued int64 state (e.g. BatchNorm num_batches_tracked) must
+    broadcast fine; only out-of-int32-range values are refused."""
+    t = torch.full((SIZE, 2), 7, dtype=torch.int64)
+    bft.broadcast_parameters([t], root_rank=3)
+    assert t.dtype == torch.int64 and t[0, 0].item() == 7
+    with pytest.raises(TypeError, match="int32 range"):
+        bft.allreduce(torch.full((SIZE, 2), 2**40, dtype=torch.int64))
+
+
+def test_add_param_group_accepts_generator():
+    c, p = quad_problem(9)
+    opt = bft.DistributedGradientAllreduceOptimizer(
+        torch.optim.SGD([p], lr=0.1)
+    )
+    extra = torch.nn.Parameter(torch.randn(SIZE, 2))
+    opt.add_param_group({"params": (q for q in [extra])})  # generator
+    assert len(opt.param_groups) == 2
+    assert len(opt.param_groups[1]["params"]) == 1  # NOT silently empty
