@@ -73,6 +73,8 @@ from bluefog_tpu.utility import (
     broadcast_optimizer_state,
     allreduce_parameters,
 )
+from bluefog_tpu import checkpoint
+from bluefog_tpu import ops
 from bluefog_tpu.timeline import (
     timeline_init,
     timeline_shutdown,

@@ -89,11 +89,15 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def flash_attention_supported(q, block_q: int = 128,
+def flash_attention_supported(q, k=None, v=None, block_q: int = 128,
                               block_k: int = 128) -> bool:
-    """Tiling feasibility: seq divisible by the blocks, head_dim a lane
+    """Tiling feasibility: self-attention shapes (the kernel assumes one
+    shared sequence length), seq divisible by the blocks, head_dim a lane
     multiple."""
     _b, t, _h, d = q.shape
+    for other in (k, v):
+        if other is not None and tuple(other.shape) != tuple(q.shape):
+            return False  # cross-attention / mismatched shapes: fall back
     return (
         t % block_q == 0 and t % block_k == 0 and d % _LANES == 0
         and t >= max(block_q, block_k)
@@ -147,7 +151,7 @@ def flash_attention(q, k, v, causal: bool = False,
     on_tpu = jax.devices()[0].platform == "tpu"
     if (
         pltpu is None
-        or not flash_attention_supported(q, block_q, block_k)
+        or not flash_attention_supported(q, k, v, block_q, block_k)
         or not (on_tpu or interpret)
     ):
         from bluefog_tpu.ops.attention import reference_attention

@@ -65,11 +65,35 @@ def from_numpy(a) -> torch.Tensor:
     return torch.from_numpy(a)
 
 
+def _restore_int64(out: torch.Tensor, orig_dtype) -> torch.Tensor:
+    """Undo the lossless int64->int32 boundary narrowing on results that
+    stayed integral (bit-moving ops); reductions that produced float keep
+    the facade's float policy."""
+    if orig_dtype == torch.int64 and out.dtype == torch.int32:
+        return out.to(torch.int64)
+    return out
+
+
 class _Allreduce(torch.autograd.Function):
     @staticmethod
     def forward(ctx, t, average):
         ctx.average = average
-        return from_numpy(col_ops.allreduce(to_numpy(t), average=average))
+        if t.dtype == torch.int64 and not average and t.numel():
+            import jax
+
+            if not jax.config.jax_enable_x64:
+                size = ctx_mod.get_context().size
+                if t.abs().max().item() * size > 2**31 - 1:
+                    raise TypeError(
+                        "int64 allreduce sum would exceed int32 range on "
+                        "the 32-bit mesh (|max| * world size overflows); "
+                        "keep such accumulators out of the distributed "
+                        "tree or enable jax_enable_x64."
+                    )
+        return _restore_int64(
+            from_numpy(col_ops.allreduce(to_numpy(t), average=average)),
+            t.dtype,
+        )
 
     @staticmethod
     def backward(ctx, grad):
@@ -90,7 +114,9 @@ class _Broadcast(torch.autograd.Function):
     @staticmethod
     def forward(ctx, t, root_rank):
         ctx.root_rank = root_rank
-        return from_numpy(col_ops.broadcast(to_numpy(t), root_rank))
+        return _restore_int64(
+            from_numpy(col_ops.broadcast(to_numpy(t), root_rank)), t.dtype
+        )
 
     @staticmethod
     def backward(ctx, grad):
@@ -166,10 +192,15 @@ def neighbor_allreduce(
 def allgather(t: torch.Tensor) -> torch.Tensor:
     """Concatenate every worker's slot along dim 0 (not differentiable,
     matching the reference TF frontend's grad-less allgather)."""
-    return from_numpy(col_ops.allgather(to_numpy(t)))
+    return _restore_int64(
+        from_numpy(col_ops.allgather(to_numpy(t))), t.dtype
+    )
 
 
 def neighbor_allgather(t: torch.Tensor) -> List[torch.Tensor]:
     """Raw in-neighbor values per rank, rank-ascending; entry ``r`` has
     shape ``[in_degree_r, ...]``."""
-    return [from_numpy(v) for v in col_ops.neighbor_allgather(to_numpy(t))]
+    return [
+        _restore_int64(from_numpy(v), t.dtype)
+        for v in col_ops.neighbor_allgather(to_numpy(t))
+    ]

@@ -1,0 +1,149 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Checkpoint/resume: a resumed run must continue bit-compatibly.
+
+The reference has no in-framework checkpointing (SURVEY §5); these tests
+pin the TPU rebuild's guarantee: save at step k, restore into a fresh
+optimizer, and the continued trajectory equals the uninterrupted one —
+including window-subsystem device state (buffers, versions, the push-sum
+p lane) and the step counter that drives dynamic schedules.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu import checkpoint as ckpt
+from bluefog_tpu import topology as tu
+from bluefog_tpu.collective.plan import schedule_from_dynamic
+
+SIZE = 8
+DIM = 3
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices):
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    bf.win_free()
+    bf.shutdown()
+
+
+def targets(seed=0):
+    return np.random.RandomState(seed).randn(SIZE, DIM).astype(np.float32)
+
+
+def grads(params, c):
+    return {"w": params["w"] - jnp.asarray(c)}
+
+
+def test_latest_step_empty(tmp_path):
+    assert ckpt.latest_step(str(tmp_path)) is None
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "nothing"))
+
+
+def test_gossip_optimizer_resume_matches_uninterrupted(tmp_path):
+    c = targets()
+    sched = schedule_from_dynamic(
+        SIZE,
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialGraph(SIZE), r
+        ),
+    )
+
+    def fresh_opt():
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.2))
+        opt.schedule = sched  # step-indexed: resume must restore the count
+        return opt
+
+    opt = fresh_opt()
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for _ in range(5):
+        params, state = opt.step(params, state, grads(params, c))
+    ckpt.save(str(tmp_path), 5, params, state, optimizer=opt)
+    # uninterrupted continuation
+    p_ref, s_ref = params, state
+    for _ in range(5):
+        p_ref, s_ref = opt.step(p_ref, s_ref, grads(p_ref, c))
+
+    # resumed continuation in a "new process" (fresh optimizer object)
+    opt2 = fresh_opt()
+    step, p2, s2 = ckpt.restore(str(tmp_path), optimizer=opt2)
+    assert step == 5
+    assert opt2._step_count == opt._step_count - 5  # saved mid-run count
+    for _ in range(5):
+        p2, s2 = opt2.step(p2, s2, grads(p2, c))
+    np.testing.assert_allclose(
+        np.asarray(p2["w"]), np.asarray(p_ref["w"]), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_window_optimizer_resume_restores_device_state(tmp_path):
+    c = targets(1)
+    bf.set_topology(tu.RingGraph(SIZE, connect_style=1))
+
+    def run(opt, state, steps):
+        for _ in range(steps):
+            est = opt.params()
+            _, state = opt.step(state, {"w": est["w"] - jnp.asarray(c)})
+        return state
+
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    state = run(opt, state, 4)
+    ckpt.save(str(tmp_path), 4, opt.params(), state, optimizer=opt)
+    ref_state = run(opt, state, 4)
+    ref = np.asarray(opt.params()["w"])
+    opt.free()
+
+    opt2 = bf.DistributedPushSumOptimizer(optax.sgd(0.1))
+    state2 = opt2.init(params)  # window re-created, then overwritten
+    step, _p, state2 = ckpt.restore(str(tmp_path), optimizer=opt2)
+    state2 = run(opt2, state2, 4)
+    got = np.asarray(opt2.params()["w"])
+    opt2.free()
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_restore_shape_mismatch_raises(tmp_path):
+    c = targets(2)
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    ckpt.save(str(tmp_path), 1, opt.params(), state, optimizer=opt)
+    opt.free()
+
+    opt2 = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    bigger = {"w": bf.worker_values(lambda r: np.zeros(DIM + 2, np.float32))}
+    opt2.init(bigger)
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.restore(str(tmp_path), optimizer=opt2)
+    opt2.free()
+
+
+def test_latest_step_picks_max(tmp_path):
+    c = targets(3)
+    opt = bf.DistributedAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for s in (1, 3, 10, 7):
+        ckpt.save(str(tmp_path), s, params, state, optimizer=opt)
+    assert ckpt.latest_step(str(tmp_path)) == 10
+    step, _, _ = ckpt.restore(str(tmp_path))
+    assert step == 10
+
+
+def test_saving_freed_window_optimizer_refuses(tmp_path):
+    c = targets(4)
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    saved_params = opt.params()
+    opt.free()
+    with pytest.raises(ValueError, match="no live window"):
+        ckpt.save(str(tmp_path), 1, saved_params, state, optimizer=opt)

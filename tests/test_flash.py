@@ -67,3 +67,17 @@ def test_scale_override():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
     )
+
+
+def test_cross_attention_shapes_fall_back():
+    """Mismatched K/V sequence length must take the dense fallback, not
+    crash in the kernel fold."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 128, 2, 128), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 256, 2, 128), jnp.float32)
+    assert not flash_attention_supported(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)

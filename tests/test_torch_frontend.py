@@ -311,3 +311,15 @@ def test_add_param_group_accepts_generator():
     opt.add_param_group({"params": (q for q in [extra])})  # generator
     assert len(opt.param_groups) == 2
     assert len(opt.param_groups[1]["params"]) == 1  # NOT silently empty
+
+
+def test_int64_results_keep_dtype_and_sum_overflow_refused():
+    """Bit-moving ops restore int64; a sum that would wrap int32 refuses."""
+    t = torch.full((SIZE, 2), 7, dtype=torch.int64)
+    assert bft.broadcast(t, 0).dtype == torch.int64
+    assert bft.allgather(t).dtype == torch.int64
+    assert bft.allreduce(t, average=False).dtype == torch.int64
+    assert bft.allreduce(t, average=False)[0, 0].item() == 7 * SIZE
+    near = torch.full((SIZE, 2), 2**28, dtype=torch.int64)  # fits int32,
+    with pytest.raises(TypeError, match="overflow"):       # sum does not
+        bft.allreduce(near, average=False)
