@@ -309,9 +309,152 @@ def run_scaling() -> int:
     return 0
 
 
+def run_gossip_overhead() -> int:
+    """Bound the gossip step's on-chip cost with communication REALLY in
+    the program: 8 virtual workers share the one chip (vmapped replicas,
+    bs/8 each), and the neighbor combine is the algebraically-identical
+    einsum with the Exp2 weight matrix over the replica axis. The delta
+    vs the combine-free step bounds the per-step gossip arithmetic +
+    memory cost; the model-size HBM roundtrip gives the per-round wire
+    floor a real ppermute pays on top (ICI transfer not measurable with
+    one chip). Emits one JSON line per measurement."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import networkx as nx
+
+    from bluefog_tpu.models import ResNet50
+    import bluefog_tpu.topology as topo
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    n_virt = int(os.environ.get("BENCH_GOSSIP_WORKERS", "8"))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    image = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "2"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
+
+    w = jnp.asarray(
+        nx.to_numpy_array(topo.ExponentialTwoGraph(n_virt)), jnp.float32
+    )
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.ones((batch, image, image, 3), jnp.bfloat16)
+    variables = model.init(rng, sample, train=True)
+    tx = optax.sgd(0.1, momentum=0.9)
+    stack = lambda tree: jax.tree_util.tree_map(
+        lambda t: jnp.broadcast_to(t[None], (n_virt,) + t.shape) + 0.0, tree
+    )
+    params = stack(variables["params"])
+    batch_stats = stack(variables["batch_stats"])
+    opt_state = jax.tree_util.tree_map(
+        lambda t: t + 0.0, stack(tx.init(variables["params"]))
+    )
+    rng_np = np.random.RandomState(0)
+    images = jnp.asarray(
+        rng_np.randn(n_virt, batch, image, image, 3), jnp.bfloat16
+    )
+    labels = jnp.asarray(
+        rng_np.randint(0, 1000, (n_virt, batch)), jnp.int32
+    )
+
+    def one_step(p, bs, s, x, y):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            return (
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean(),
+                mutated["batch_stats"],
+            )
+
+        (loss, nbs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), nbs, s, loss
+
+    def make(gossip):
+        def step(params, batch_stats, opt_state, images, labels):
+            p, nbs, s, loss = jax.vmap(one_step)(
+                params, batch_stats, opt_state, images, labels
+            )
+            if gossip:
+                # y_j = sum_i W[i, j] x_i over the replica axis — the
+                # exact neighbor_allreduce combine, on-chip
+                p = jax.tree_util.tree_map(
+                    lambda t: jnp.einsum(
+                        "ij,i...->j...", w.astype(t.dtype), t
+                    ),
+                    p,
+                )
+            return p, nbs, s, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def timed(fn, state):
+        params, batch_stats, opt_state = state
+        for _ in range(warmup):
+            params, batch_stats, opt_state, loss = fn(
+                params, batch_stats, opt_state, images, labels
+            )
+        _settle(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, batch_stats, opt_state, loss = fn(
+                params, batch_stats, opt_state, images, labels
+            )
+        _settle(loss)
+        t1 = time.perf_counter()
+        _settle(loss)
+        t_read = time.perf_counter() - t1
+        return max(t1 - t0 - t_read, 1e-9) / steps
+
+    copy = lambda tr: jax.tree_util.tree_map(lambda t: t + 0.0, tr)
+    dt_plain = timed(make(False), (copy(params), copy(batch_stats),
+                                   copy(opt_state)))
+    dt_gossip = timed(make(True), (params, batch_stats, opt_state))
+
+    # wire floor: one model-size HBM roundtrip (a ppermute's on-chip
+    # cost). Sub-ms per iteration, so run many to dominate the readback
+    # correction.
+    flat = jnp.zeros((25_557_032,), jnp.float32)
+    bump = jax.jit(lambda t: t + 1.0)
+    copy_iters = 20 * steps
+    for _ in range(warmup):
+        flat = bump(flat)
+    _settle(flat[:1])
+    t0 = time.perf_counter()
+    for _ in range(copy_iters):
+        flat = bump(flat)
+    _settle(flat[:1])
+    t1 = time.perf_counter()
+    _settle(flat[:1])
+    dt_copy = max(t1 - t0 - (time.perf_counter() - t1), 1e-9) / copy_iters
+
+    total = n_virt * batch
+    for line in (
+        {"metric": "gossip_step_no_comm", "workers_on_chip": n_virt,
+         "imgs_per_sec": round(total / dt_plain, 1),
+         "ms_per_step": round(dt_plain * 1e3, 2)},
+        {"metric": "gossip_step_with_combine", "workers_on_chip": n_virt,
+         "imgs_per_sec": round(total / dt_gossip, 1),
+         "ms_per_step": round(dt_gossip * 1e3, 2),
+         "gossip_overhead_pct": round(
+             100.0 * (dt_gossip - dt_plain) / dt_plain, 2)},
+        {"metric": "model_hbm_roundtrip", "ms": round(dt_copy * 1e3, 3)},
+    ):
+        print(json.dumps(line))
+    return 0
+
+
 def main() -> int:
-    if os.environ.get("BENCH_MODE", "") == "scaling":
+    mode = os.environ.get("BENCH_MODE", "")
+    if mode == "scaling":
         return run_scaling()
+    if mode == "gossip":
+        return run_gossip_overhead()
     return run_headline()
 
 

@@ -116,7 +116,23 @@ def restore(path: str, step: Optional[int] = None,
     if optimizer is not None:
         if "opt_step_count" in payload:
             optimizer._step_count = int(payload["opt_step_count"])
+        elif getattr(optimizer, "_step_count", None) is not None:
+            # the checkpoint was saved without `optimizer=`, so the
+            # schedule-driving counter is absent; restoring silently would
+            # restart dynamic schedules at round 0 and diverge
+            raise ValueError(
+                "checkpoint has no optimizer step counter but the given "
+                "optimizer is step-indexed; re-save with "
+                "save(..., optimizer=opt)"
+            )
         wstate = payload.get("window")
+        from bluefog_tpu.optimizers import _WindowOptimizer
+
+        if wstate is None and isinstance(optimizer, _WindowOptimizer):
+            raise ValueError(
+                "checkpoint has no window state but the given optimizer is "
+                "a window optimizer; re-save with save(..., optimizer=opt)"
+            )
         if wstate is not None:
             name = getattr(optimizer, "_name", None)
             if name is None:

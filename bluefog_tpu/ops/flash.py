@@ -89,7 +89,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def flash_attention_supported(q, k=None, v=None, block_q: int = 128,
+def flash_attention_supported(q, k=None, v=None, *, block_q: int = 128,
                               block_k: int = 128) -> bool:
     """Tiling feasibility: self-attention shapes (the kernel assumes one
     shared sequence length), seq divisible by the blocks, head_dim a lane
@@ -151,7 +151,8 @@ def flash_attention(q, k, v, causal: bool = False,
     on_tpu = jax.devices()[0].platform == "tpu"
     if (
         pltpu is None
-        or not flash_attention_supported(q, k, v, block_q, block_k)
+        or not flash_attention_supported(q, k, v, block_q=block_q,
+                                         block_k=block_k)
         or not (on_tpu or interpret)
     ):
         from bluefog_tpu.ops.attention import reference_attention

@@ -147,3 +147,27 @@ def test_saving_freed_window_optimizer_refuses(tmp_path):
     opt.free()
     with pytest.raises(ValueError, match="no live window"):
         ckpt.save(str(tmp_path), 1, saved_params, state, optimizer=opt)
+
+
+def test_restore_without_saved_optimizer_state_refuses(tmp_path):
+    """A checkpoint saved WITHOUT optimizer= lacks the step counter and
+    window lanes; restoring it INTO an optimizer must refuse rather than
+    silently resume divergently."""
+    c = targets(5)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    params, state = opt.step(params, state, grads(params, c))
+    ckpt.save(str(tmp_path), 1, params, state)  # no optimizer=
+    with pytest.raises(ValueError, match="step counter"):
+        ckpt.restore(str(tmp_path), optimizer=opt)
+
+    wopt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    wstate = wopt.init(params)
+    ckpt.save(str(tmp_path / "w"), 1, wopt.params(), wstate)
+    wopt2 = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+    wopt2.init(params)
+    # window optimizers have no _step_count; the window check must fire
+    with pytest.raises(ValueError, match="window state"):
+        ckpt.restore(str(tmp_path / "w"), optimizer=wopt2)
+    wopt.free(); wopt2.free()
