@@ -86,8 +86,11 @@ def run_headline() -> int:
     # so the driver always gets a line.
     batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "4"))
     image = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "32"))
-    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "5" if on_tpu else "1"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3")))
+    # >=1: the timing loop settles on the warmup's last loss
+    warmup = max(
+        1, int(os.environ.get("BENCH_WARMUP", "5" if on_tpu else "1"))
+    )
 
     mesh = Mesh(np.array(devices), ("workers",))
     plan = planlib.plan_from_topology(
@@ -331,8 +334,11 @@ def run_gossip_overhead() -> int:
     n_virt = int(os.environ.get("BENCH_GOSSIP_WORKERS", "8"))
     batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
     image = int(os.environ.get("BENCH_IMAGE", "224" if on_tpu else "32"))
-    steps = int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "2"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "2")))
+    # >=1: the timing loop settles on the warmup's last loss
+    warmup = max(
+        1, int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
+    )
 
     w = jnp.asarray(
         nx.to_numpy_array(topo.ExponentialTwoGraph(n_virt)), jnp.float32

@@ -9,5 +9,6 @@ implementations so the BASELINE configs are reproducible without torch.
 
 from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from bluefog_tpu.models.mlp import MLP, MnistCNN
+from bluefog_tpu.models.transformer import TransformerLM
 
-__all__ = ["ResNet", "ResNet18", "ResNet50", "MLP", "MnistCNN"]
+__all__ = ["ResNet", "ResNet18", "ResNet50", "MLP", "MnistCNN", "TransformerLM"]

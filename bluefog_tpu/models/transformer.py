@@ -1,0 +1,93 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Decoder-only transformer LM with pluggable sequence-parallel attention.
+
+The reference has no transformer (its examples are ResNet/MNIST-scale,
+data-parallel only); this model exists so the framework's long-context
+layer (:mod:`bluefog_tpu.ops.attention`) can be exercised end-to-end: the
+attention implementation is injected, so the SAME module runs dense on
+one device or ring/Ulysses sequence-parallel inside ``shard_map`` —
+weights are identical either way, which is what the equivalence tests
+rely on.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from bluefog_tpu.ops.attention import reference_attention
+
+__all__ = ["TransformerLM"]
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    attend: Callable
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(
+            t.shape[0], t.shape[1], self.heads, self.dim // self.heads
+        )
+        att = self.attend(split(q), split(k), split(v))
+        att = att.reshape(x.shape[0], x.shape[1], self.dim)
+        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype)(att)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(4 * self.dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.dim, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """Tiny causal LM. ``attend(q, k, v)`` defaults to dense causal
+    attention; pass a sequence-parallel block function (closed over the
+    mesh axis) to shard the sequence. Positions are GLOBAL: pass
+    ``pos_offset`` = this worker's first token index so sequence-sharded
+    workers embed their true positions."""
+
+    vocab: int = 64
+    dim: int = 32
+    heads: int = 4
+    layers: int = 2
+    max_len: int = 4096
+    dtype: Any = jnp.float32
+    attend: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        attend = self.attend or (
+            lambda q, k, v: reference_attention(q, k, v, causal=True)
+        )
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype)(tokens)
+        pos_table = self.param(
+            "pos", nn.initializers.normal(0.02), (self.max_len, self.dim)
+        )
+        if isinstance(pos_offset, int):
+            # static offsets are checkable at trace time; the gather below
+            # would silently CLAMP out-of-range positions otherwise
+            if tokens.shape[1] + pos_offset > self.max_len:
+                raise ValueError(
+                    f"sequence of {tokens.shape[1]} tokens at offset "
+                    f"{pos_offset} exceeds max_len={self.max_len}"
+                )
+        elif tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"block of {tokens.shape[1]} tokens exceeds "
+                f"max_len={self.max_len}"
+            )
+        pos = (
+            jnp.arange(tokens.shape[1]) + pos_offset
+        )  # global positions under sequence sharding
+        x = x + pos_table[pos][None].astype(self.dtype)
+        for _ in range(self.layers):
+            x = Block(
+                dim=self.dim, heads=self.heads, attend=attend,
+                dtype=self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab, dtype=jnp.float32)(x)

@@ -61,3 +61,9 @@ def test_benchmark_static_and_dynamic():
         "benchmark.py", "--model", "mlp", "--dynamic", "--num-iters", "3"
     )
     assert "imgs/sec" in out
+
+
+@pytest.mark.example
+def test_long_context():
+    out = run_example("long_context.py")
+    assert "PASSED" in out
