@@ -5,7 +5,7 @@
 Beyond-reference capability demo (the reference is data-parallel only):
 a tiny causal LM trains on sequences 8x longer than any single worker
 holds — each worker owns one sequence block, K/V rotate around the ring
-(`bluefog_tpu.ops.ring_attention_block`), gradients are psum-averaged,
+(`bluefog_tpu.ops.ring_attention_block`), partial gradients are psum-combined,
 and the result is verified equivalent to the same model trained dense on
 the full sequence.
 
@@ -46,9 +46,11 @@ def main() -> int:
     ]
     tokens, targets = stream[:, :-1], stream[:, 1:]
 
-    model = TransformerLM(vocab=vocab, dim=32, heads=4, layers=2,
-                          max_len=total_len)
-    params = model.init(
+    def make_model(attend=None):
+        return TransformerLM(vocab=vocab, dim=32, heads=4, layers=2,
+                             max_len=total_len, attend=attend)
+
+    params = make_model().init(
         jax.random.PRNGKey(0), jnp.asarray(tokens[:, :block])
     )
     tx = optax.adam(1e-2)
@@ -61,32 +63,29 @@ def main() -> int:
     tok_s = jax.device_put(shard(tokens), sharding)
     tgt_s = jax.device_put(shard(targets), sharding)
 
+    ring = lambda q, k, v: ring_attention_block(q, k, v, "seq", causal=True)
+
+    def sp_global_loss(p, tok, tgt, my):
+        """Mean loss over the GLOBAL sequence, from one worker's block."""
+        logits = make_model(ring).apply(p, tok, pos_offset=my * block)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt
+        )
+        return jax.lax.psum(losses.sum(), "seq") / (batch * total_len)
+
     def step(params, opt_state, tok, tgt):
         """Sequence-parallel train step (runs per worker in shard_map)."""
         my = jax.lax.axis_index("seq")
         tok, tgt = tok[0], tgt[0]
 
         def loss_fn(p):
-            sp_model = TransformerLM(
-                vocab=vocab, dim=32, heads=4, layers=2, max_len=total_len,
-                attend=lambda q, k, v: ring_attention_block(
-                    q, k, v, "seq", causal=True
-                ),
-            )
-            logits = sp_model.apply(p, tok, pos_offset=my * block)
-            losses = optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgt
-            )
-            # mean over the GLOBAL sequence = psum of block sums / total
-            return jax.lax.psum(losses.sum(), "seq") / (
-                batch * total_len
-            )
+            return sp_global_loss(p, tok, tgt, my)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        # data-parallel-style gradient agreement: every worker computed
-        # grads from its block; average them (they already share params)
+        # psum's VJP is identity, so each worker's grad is its PARTIAL of
+        # the global loss; the true gradient is their SUM across workers.
         grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, "seq"), grads
+            lambda g: jax.lax.psum(g, "seq"), grads
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
@@ -103,20 +102,7 @@ def main() -> int:
     def sp_eval(params, tok, tgt):
         """Ring-attention loss at the CURRENT params (no update)."""
         my = jax.lax.axis_index("seq")
-        tok, tgt = tok[0], tgt[0]
-        sp_model = TransformerLM(
-            vocab=vocab, dim=32, heads=4, layers=2, max_len=total_len,
-            attend=lambda q, k, v: ring_attention_block(
-                q, k, v, "seq", causal=True
-            ),
-        )
-        logits = sp_model.apply(params, tok, pos_offset=my * block)
-        losses = optax.softmax_cross_entropy_with_integer_labels(
-            logits, tgt
-        )
-        return (
-            jax.lax.psum(losses.sum(), "seq") / (batch * total_len)
-        ).reshape(())
+        return sp_global_loss(params, tok[0], tgt[0], my).reshape(())
 
     eval_fn = jax.jit(
         jax.shard_map(
@@ -136,9 +122,7 @@ def main() -> int:
     print(f"[ring-attention LM] loss {first:.3f} -> {sp_loss:.4f} "
           f"(seq {total_len} over {n} workers)")
 
-    dense = TransformerLM(vocab=vocab, dim=32, heads=4, layers=2,
-                          max_len=total_len)
-    logits = dense.apply(params, jnp.asarray(tokens))
+    logits = make_model().apply(params, jnp.asarray(tokens))
     dense_loss = float(
         optax.softmax_cross_entropy_with_integer_labels(
             logits, jnp.asarray(targets)

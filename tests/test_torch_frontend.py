@@ -323,3 +323,25 @@ def test_int64_results_keep_dtype_and_sum_overflow_refused():
     near = torch.full((SIZE, 2), 2**28, dtype=torch.int64)  # fits int32,
     with pytest.raises(TypeError, match="overflow"):       # sum does not
         bft.allreduce(near, average=False)
+
+
+def test_neighbor_optimizer_dynamic_topology_idiom():
+    """The reference's per-iteration weight-reassignment idiom
+    (README.rst:108-123) through the torch wrapper: assign self/src/dst
+    between steps; peers move with no error and consensus still forms."""
+    c, p = quad_problem(11)
+    opt = bft.DistributedNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=0.2)
+    )
+    for i in range(40):
+        shift = 1 + (i % 2)  # alternate one-peer ring distance 1 / 2
+        opt.self_weight = 0.5
+        opt.src_weights = [{(r - shift) % SIZE: 0.5} for r in range(SIZE)]
+        opt.dst_weights = [[(r + shift) % SIZE] for r in range(SIZE)]
+        opt.zero_grad()
+        (0.5 * ((p - torch.from_numpy(c)) ** 2).sum()).backward()
+        opt.step()
+        opt.param_groups[0]["lr"] *= 0.95
+    w = p.data.numpy()
+    assert np.abs(w - w.mean(0)).max() < 0.25
+    assert np.abs(w.mean(0) - c.mean(0)).max() < 0.1
