@@ -158,6 +158,9 @@ class _GossipOptimizer:
         self.src_weights = None
         self.dst_weights = None
         self.enable_topo_check = True
+        # 'int8' quantizes the gossip wire payload (4x fewer bytes; see
+        # inner.weighted_combine_quantized). Static-plan path only.
+        self.compression = None
         self.schedule: Optional[SchedulePlan] = None
         # Hierarchical knobs (reference mpi_ops.py:648-821).
         self.neighbor_machine_weights = None
@@ -221,6 +224,23 @@ class _GossipOptimizer:
                 "neighbor_allreduce or hierarchical communication; "
                 f"this optimizer uses {comm.value!r}"
             )
+        if self.compression is not None:
+            # validate centrally: a silently-ignored knob would make the
+            # user believe wire bytes dropped 4x when nothing changed
+            if self.compression != "int8":
+                raise ValueError(
+                    "compression must be None or 'int8', got "
+                    f"{self.compression!r}"
+                )
+            if (
+                comm != CommunicationType.neighbor_allreduce
+                or self.schedule is not None
+            ):
+                raise ValueError(
+                    "compression='int8' is only supported on the "
+                    "static-plan neighbor_allreduce path (not schedules, "
+                    "allreduce, hierarchical, or empty communication)"
+                )
         if comm == CommunicationType.empty:
             return ("empty",), (lambda t, step, wops: t), ()
         if comm == CommunicationType.allreduce:
@@ -255,6 +275,20 @@ class _GossipOptimizer:
             )
             perms = plan.perms
             self_w, recv_w = plan.weight_operands()
+            if self.compression is not None:
+                inner._check_combine_normalized(plan, "compression='int8'")
+                # keyed on the edge STRUCTURE with weights as operands —
+                # per-step varying weights reuse one compiled program,
+                # same guarantee as the exact path
+                return (
+                    ("na_q", perms),
+                    lambda t, step, wops: (
+                        inner.weighted_combine_quantized_operands(
+                            t, perms, wops[0], ctx_mod.WORKER_AXIS
+                        )
+                    ),
+                    (jnp.asarray(recv_w),),
+                )
             return (
                 ("na", perms),
                 lambda t, step, wops: inner.weighted_combine_operands(

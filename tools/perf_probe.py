@@ -135,7 +135,8 @@ def probe_resnet():
         kfn = jax.jit(k_steps)
         dtk = timed(lambda s: kfn(s, images, labels), state, iters=3) / K
 
-        flops_img = 3 * 4.1e9  # fwd+bwd ~= 3x fwd, ResNet50 ~4.1 GFLOP/img
+        # 2*MAC convention (matches bench.py): fwd ~= 8.2 GFLOP/img
+        flops_img = 3 * 8.2e9
         emit(probe="resnet", batch=batch,
              ms_per_step_1call=round(dt1 * 1e3, 2),
              ms_per_step_kloop=round(dtk * 1e3, 2),
