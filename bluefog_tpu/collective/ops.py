@@ -353,8 +353,6 @@ def neighbor_allreduce_nonblocking(
         raise ValueError(
             f"compression must be None or 'int8', got {compression!r}"
         )
-    if compression == "int8":
-        inner._check_combine_normalized(plan, "compression='int8'")
     combine = (
         inner.weighted_combine_quantized
         if compression == "int8"

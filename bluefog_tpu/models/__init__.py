@@ -7,8 +7,13 @@ The reference treats models as externals (torchvision ResNet50 in
 implementations so the BASELINE configs are reproducible without torch.
 """
 
-from bluefog_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from bluefog_tpu.models.resnet import (
+    ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)
 from bluefog_tpu.models.mlp import MLP, MnistCNN
 from bluefog_tpu.models.transformer import TransformerLM
 
-__all__ = ["ResNet", "ResNet18", "ResNet50", "MLP", "MnistCNN", "TransformerLM"]
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "MLP", "MnistCNN", "TransformerLM",
+]

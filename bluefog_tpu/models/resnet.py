@@ -14,7 +14,7 @@ from typing import Any, Callable, Sequence, Tuple
 import jax.numpy as jnp
 import flax.linen as nn
 
-__all__ = ["ResNet", "ResNet18", "ResNet50"]
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152"]
 
 ModuleDef = Any
 
@@ -115,6 +115,13 @@ class ResNet(nn.Module):
 
 
 ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
 ResNet50 = functools.partial(
     ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock
+)
+ResNet101 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock
+)
+ResNet152 = functools.partial(
+    ResNet, stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock
 )

@@ -232,14 +232,15 @@ class _GossipOptimizer:
                     "compression must be None or 'int8', got "
                     f"{self.compression!r}"
                 )
-            if (
-                comm != CommunicationType.neighbor_allreduce
-                or self.schedule is not None
-            ):
+            if comm not in (
+                CommunicationType.neighbor_allreduce,
+                CommunicationType.hierarchical_neighbor_allreduce,
+            ) or self.schedule is not None:
                 raise ValueError(
                     "compression='int8' is only supported on the "
-                    "static-plan neighbor_allreduce path (not schedules, "
-                    "allreduce, hierarchical, or empty communication)"
+                    "static-plan neighbor_allreduce and hierarchical "
+                    "paths (not schedules, allreduce, or empty "
+                    "communication)"
                 )
         if comm == CommunicationType.empty:
             return ("empty",), (lambda t, step, wops: t), ()
@@ -321,6 +322,21 @@ class _GossipOptimizer:
         mplan = self._machine_plan(ctx)
         perms = mplan.perms
         self_w, recv_w = mplan.weight_operands()
+        if self.compression is not None:
+            # compress the MACHINE-level (DCN) leg — the transfer that
+            # actually scales with pod count; the intra-host psum stays
+            # exact on ICI
+            inner._check_combine_normalized(mplan, "compression='int8'")
+            return (
+                ("hier_q", perms),
+                lambda t, step, wops: (
+                    inner.hierarchical_neighbor_allreduce_quantized(
+                        t, perms, wops[0],
+                        ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS
+                    )
+                ),
+                (jnp.asarray(recv_w),),
+            )
         return (
             ("hier", perms),
             lambda t, step, wops: inner.hierarchical_neighbor_allreduce_operands(

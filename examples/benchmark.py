@@ -38,7 +38,11 @@ WINDOW_MODES = ("win_put", "push_sum")
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="mlp", choices=["mlp", "resnet50"])
+    parser.add_argument(
+        "--model", default="mlp",
+        choices=["mlp", "resnet18", "resnet34", "resnet50", "resnet101",
+                 "resnet152"],
+    )
     parser.add_argument(
         "--dist-optimizer", default="neighbor_allreduce",
         choices=sorted(OPTIMIZERS),
@@ -55,10 +59,12 @@ def main() -> int:
     bf.init(devices=devices)
     size = bf.size()
 
-    if args.model == "resnet50":
-        from bluefog_tpu.models import ResNet50
+    if args.model.startswith("resnet"):
+        from bluefog_tpu import models as model_zoo
 
-        model = ResNet50(num_classes=1000)
+        model = getattr(model_zoo, args.model.replace("resnet", "ResNet"))(
+            num_classes=1000
+        )
         sample = jnp.ones((args.batch_size, 64, 64, 3), jnp.float32)
         variables = model.init(jax.random.PRNGKey(0), sample, train=False)
         apply = lambda p, x: model.apply(p, x, train=False)

@@ -75,8 +75,8 @@ def test_wire_bytes_are_one_quarter():
     base = lower(inner.weighted_combine)["collective-permute"]
     quant = lower(inner.weighted_combine_quantized)["collective-permute"]
     assert base["bytes"] == 2 * D * 4  # 2 ring rounds, f32
-    # int8 payload + 4-byte scale per round
-    assert quant["bytes"] <= base["bytes"] // 4 + 2 * 4, (base, quant)
+    # int8 payload + per-512-chunk f32 scales (~0.8% of payload) per round
+    assert quant["bytes"] <= int(base["bytes"] // 4 * 1.05), (base, quant)
 
 
 def test_optimizer_with_compression_converges():
@@ -132,7 +132,7 @@ def test_non_normalized_weights_refused():
 
 def test_compression_refused_off_static_path():
     """opt.compression must raise, not silently no-op, on paths that do
-    not support it (schedules / allreduce / hierarchical)."""
+    not support it (schedules / allreduce)."""
     from bluefog_tpu.collective.plan import schedule_from_dynamic
 
     x = bf.worker_values(lambda r: np.ones(4, np.float32))
@@ -177,3 +177,25 @@ def test_compressed_varying_weights_single_program():
         if i == 0:
             before = len(ctx.op_cache)
     assert len(ctx.op_cache) == before  # no recompiles across weights
+
+
+def test_hierarchical_compression_converges(cpu_devices):
+    """int8 on the machine-level (DCN) leg: intra-host psum exact,
+    cross-host gossip quantized; training still reaches consensus."""
+    bf.shutdown()
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=4)
+    bf.set_machine_topology(tu.RingGraph(2))
+    c = np.random.RandomState(4).randn(SIZE, 4).astype(np.float32)
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 10, 0.5))
+    )
+    opt.compression = "int8"
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for _ in range(60):
+        params, state = opt.step(params, state,
+                                 {"w": params["w"] - jnp.asarray(c)})
+    w = np.asarray(params["w"])
+    target = c.mean(0)
+    assert np.abs(w - target).max() < 0.15 * np.abs(c - target).max()
+    assert np.abs(w - w.mean(0)).max() < 0.1

@@ -301,3 +301,26 @@ def test_uninitialized_raises():
     with pytest.raises(RuntimeError, match="not initialized"):
         bf.size()
     bf.init()  # restore for the autouse fixture's shutdown
+
+
+def test_resnet_family_shapes():
+    """torchvision-parity model zoo: every ResNet depth builds and runs
+    (the reference benchmarks arbitrary torchvision models,
+    examples/pytorch_benchmark.py:60-75)."""
+    import jax
+    import jax.numpy as jnp
+    from bluefog_tpu import models as zoo
+
+    expected = {
+        "ResNet18": 11.2e6, "ResNet34": 21.3e6, "ResNet50": 23.6e6,
+        "ResNet101": 42.5e6, "ResNet152": 58.2e6,
+    }
+    for name, approx in expected.items():
+        m = getattr(zoo, name)(num_classes=10)
+        v = m.init(jax.random.PRNGKey(0),
+                   jnp.ones((1, 32, 32, 3), jnp.bfloat16), train=False)
+        out = m.apply(v, jnp.ones((1, 32, 32, 3), jnp.bfloat16),
+                      train=False)
+        assert out.shape == (1, 10)
+        n = sum(p.size for p in jax.tree_util.tree_leaves(v["params"]))
+        assert abs(n - approx) / approx < 0.05, (name, n)
