@@ -48,13 +48,29 @@ __all__ = [
 ]
 
 
+def _expand_kv(q, kv):
+    """Grouped-query attention: K/V may carry fewer heads than Q
+    (``h % h_kv == 0``); repeat each KV head over its query group."""
+    h, h_kv = q.shape[2], kv.shape[2]
+    if h == h_kv:
+        return kv
+    if h % h_kv != 0:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})"
+        )
+    return jnp.repeat(kv, h // h_kv, axis=2)
+
+
 def reference_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None):
     """Dense softmax attention on full (unsharded) tensors
     ``[batch, seq, heads, dim]`` — the numpy-oracle-grade reference the
-    sequence-parallel paths are tested against."""
+    sequence-parallel paths are tested against. K/V with fewer heads than
+    Q run grouped-query attention (each KV head serves ``h/h_kv``
+    query heads)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    k, v = _expand_kv(q, k), _expand_kv(q, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
@@ -78,7 +94,10 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
     softmax attention of the logically-concatenated sequence, computed
     with f32 online-softmax accumulation (reductions are reordered vs a
     dense computation, so equality is numerical — rtol ~1e-5 at f32 —
-    not bitwise).
+    not bitwise). Grouped-query attention (K/V with fewer heads) rotates
+    the COMPACT K/V around the ring and expands per round on the
+    receiver, so GQA also divides the ring's wire bytes by the group
+    factor.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -103,7 +122,8 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
 
     def attend(r, kcur, vcur, acc, m, l):
         src = (my - r) % n  # whose K/V block this worker holds this round
-        s = _block_scores(q, kcur, scale).astype(jnp.float32)  # [b,h,t,t]
+        kx, vx = _expand_kv(q, kcur), _expand_kv(q, vcur)
+        s = _block_scores(q, kx, scale).astype(jnp.float32)  # [b,h,t,t]
         if causal:
             qpos = my * t + jnp.arange(t)
             kpos = src * t + jnp.arange(t)
@@ -120,7 +140,7 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
         l = l * corr + p.sum(-1)
         acc = (
             acc * corr.transpose(0, 2, 1)[..., None]
-            + jnp.einsum("bhqk,bkhd->bqhd", p, vcur.astype(jnp.float32))
+            + jnp.einsum("bhqk,bkhd->bqhd", p, vx.astype(jnp.float32))
         )
         return acc, m_new, l
 
@@ -153,12 +173,23 @@ def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,
     be divisible by the mesh size.
     """
     n = lax.psum(1, axis_name)
-    h = q.shape[2]
+    h, h_kv = q.shape[2], k.shape[2]
     if h % n != 0:
         raise ValueError(
             f"ulysses attention needs heads ({h}) divisible by mesh "
             f"size ({n})"
         )
+    if h % h_kv != 0:
+        # validate at entry with the GLOBAL head counts; otherwise the
+        # failure surfaces mid-trace with confusing per-shard counts
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})"
+        )
+    # GQA: reshard the compact KV when its head count divides the mesh
+    # (group alignment holds because both splits are contiguous);
+    # otherwise expand to full heads first — correct, just not compact.
+    if h_kv % n != 0:
+        k, v = _expand_kv(q, k), _expand_kv(q, v)
 
     def seq_to_heads(x):
         # [b, t, h, d] -> concat seq, split heads -> [b, t*n, h/n, d]
@@ -172,6 +203,10 @@ def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,
         )
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # expand a compact-resharded KV locally: the wire stayed compact, and
+    # matching head counts keep the Pallas flash kernel eligible (its
+    # support predicate requires equal Q/KV shapes)
+    kf, vf = _expand_kv(qf, kf), _expand_kv(qf, vf)
     # local attention hot op: Pallas flash kernel on TPU when the tiling
     # allows, dense XLA otherwise (same math; see ops/flash.py)
     from bluefog_tpu.ops.flash import flash_attention

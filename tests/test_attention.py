@@ -166,3 +166,129 @@ def test_facade_validates_all_operands():
     bad_k = np.asarray(ks)[: SIZE - 1]  # wrong leading axis
     with pytest.raises(ValueError, match="worker array"):
         ring_attention(np.asarray(qs), bad_k, np.asarray(vs))
+
+
+def test_gqa_ring_matches_dense():
+    """Grouped-query attention: 8 query heads over 2 KV heads; the ring
+    rotates the compact KV (wire bytes / 4) and must still equal dense
+    GQA, which itself must equal repeated-head MHA."""
+    rng = np.random.RandomState(6)
+    h_kv = 2
+    qf = rng.randn(B, SIZE * T, H, D).astype(np.float32)
+    kf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+    vf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+    dense = reference_attention(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), causal=True
+    )
+    # oracle: GQA == MHA with explicitly repeated KV heads
+    rep = lambda a: np.repeat(a, H // h_kv, axis=2)
+    mha = reference_attention(
+        jnp.asarray(qf), jnp.asarray(rep(kf)), jnp.asarray(rep(vf)),
+        causal=True,
+    )
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(mha),
+                               rtol=1e-6, atol=1e-6)
+
+    stack = lambda a: np.stack(np.split(a, SIZE, axis=1))
+    got = np.asarray(
+        ring_attention(stack(qf), stack(kf), stack(vf), causal=True)
+    )
+    got_full = got.transpose(1, 0, 2, 3, 4).reshape(B, SIZE * T, H, D)
+    np.testing.assert_allclose(got_full, np.asarray(dense), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_gqa_ring_wire_bytes_are_compact():
+    """The rotated payload is the COMPACT KV: wire bytes divide by the
+    group factor vs MHA."""
+    from bluefog_tpu import scaling
+
+    h_kv = 2
+    mesh = jax.make_mesh((SIZE,), ("workers",))
+    spec = P("workers")
+
+    def lower(h_kv_heads):
+        fn = jax.jit(
+            jax.shard_map(
+                lambda q, k, v: ring_attention_block(
+                    q[0], k[0], v[0], "workers"
+                )[None],
+                mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+            )
+        )
+        q = jnp.zeros((SIZE, B, T, H, D))
+        kv = jnp.zeros((SIZE, B, T, h_kv_heads, D))
+        args = [
+            jax.device_put(a, NamedSharding(mesh, spec))
+            for a in (q, kv, kv)
+        ]
+        stats = scaling.hlo_collective_stats(
+            fn.lower(*args).compile().as_text()
+        )
+        return stats["collective-permute"]["bytes"]
+
+    assert lower(h_kv) * (H // h_kv) == lower(H)
+
+
+def test_gqa_rejects_indivisible_heads():
+    q = jnp.zeros((1, 8, 6, 16))
+    kv = jnp.zeros((1, 8, 4, 16))
+    with pytest.raises(ValueError, match="multiple"):
+        reference_attention(q, kv, kv)
+
+
+def test_gqa_ulysses_matches_dense():
+    """h_kv=2 < mesh size exercises the expand-first path; the compact
+    reshard path (h_kv divisible by mesh) is covered separately, and
+    h_kv == H is plain MHA already covered elsewhere."""
+    rng = np.random.RandomState(7)
+    for h_kv in (2,):
+        qf = rng.randn(B, SIZE * T, H, D).astype(np.float32)
+        kf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+        vf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+        dense = reference_attention(
+            jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), causal=True
+        )
+        stack = lambda a: np.stack(np.split(a, SIZE, axis=1))
+        got = np.asarray(
+            ulysses_attention(stack(qf), stack(kf), stack(vf), causal=True)
+        )
+        got_full = got.transpose(1, 0, 2, 3, 4).reshape(B, SIZE * T, H, D)
+        np.testing.assert_allclose(got_full, np.asarray(dense), rtol=2e-5,
+                                   atol=2e-5, err_msg=f"h_kv={h_kv}")
+
+
+def test_gqa_ulysses_compact_reshard_path():
+    """16 query heads over 8 KV heads on an 8-mesh: the KV reshard stays
+    COMPACT (h_kv % n == 0) and group alignment must hold."""
+    rng = np.random.RandomState(8)
+    H2, h_kv = 16, 8
+    qf = rng.randn(B, SIZE * T, H2, D).astype(np.float32)
+    kf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+    vf = rng.randn(B, SIZE * T, h_kv, D).astype(np.float32)
+    dense = reference_attention(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), causal=True
+    )
+    stack = lambda a: np.stack(np.split(a, SIZE, axis=1))
+    got = np.asarray(
+        ulysses_attention(stack(qf), stack(kf), stack(vf), causal=True)
+    )
+    got_full = got.transpose(1, 0, 2, 3, 4).reshape(B, SIZE * T, H2, D)
+    np.testing.assert_allclose(got_full, np.asarray(dense), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_gqa_ulysses_invalid_group_raises_at_entry():
+    """h divisible by mesh but not by h_kv must fail with GLOBAL head
+    counts at entry, not mid-trace with per-shard counts."""
+    mesh = jax.make_mesh((2,), ("workers",))
+    spec = P("workers")
+    q = jnp.zeros((2, 1, 8, 8, 16))
+    kv = jnp.zeros((2, 1, 8, 6, 16))
+    with pytest.raises(ValueError, match=r"\(8\).*\(6\)"):
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention_block(
+                q[0], k[0], v[0], "workers"
+            )[None],
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        )(q, kv, kv)
