@@ -47,24 +47,13 @@ def _peak_flops(device) -> float:
     return 0.0
 
 
-_TAKE = None
-
-
 def _settle(x):
-    """block_until_ready can be a no-op on remote-tunneled platforms; a
-    host readback of one element provably waits for the step. The readback
-    goes through a tiny jitted gather producing a FRESH scalar array each
-    call: ``np.asarray`` directly on the step output would cache its host
-    value on the array object, so a second settle of the same object could
-    not measure readback latency (it made the r3 bench under-report by
-    ~25 %: the full first-readback cost stayed inside the timed window)."""
-    import numpy as np
-    import jax
+    """Tunnel-safe sync point (see bluefog_tpu.timing.settle: a plain
+    np.asarray readback would cache on the array object and break the
+    readback-latency correction — the round-3 ~25% under-report)."""
+    from bluefog_tpu.timing import settle
 
-    global _TAKE
-    if _TAKE is None:
-        _TAKE = jax.jit(lambda t: t.ravel()[0])
-    return float(np.asarray(_TAKE(x)))
+    return settle(x)
 
 
 def run_headline() -> int:

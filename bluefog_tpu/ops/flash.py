@@ -148,6 +148,8 @@ def flash_attention(q, k, v, causal: bool = False,
     otherwise falls back to the dense XLA attention (same math)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    from bluefog_tpu.ops.attention import reference_attention
+
     on_tpu = jax.devices()[0].platform == "tpu"
     if (
         pltpu is None
@@ -155,8 +157,6 @@ def flash_attention(q, k, v, causal: bool = False,
                                          block_k=block_k)
         or not (on_tpu or interpret)
     ):
-        from bluefog_tpu.ops.attention import reference_attention
-
         return reference_attention(q, k, v, causal=causal, scale=scale)
     return _flash(q, k, v, causal, float(scale), block_q, block_k,
                   interpret)

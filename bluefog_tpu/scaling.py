@@ -168,15 +168,10 @@ def weak_scaling_times(
     no-op on remote-tunneled platforms). Per-worker work must be constant
     across ``ns`` (weak scaling), so ``efficiency = t[0] / t[n]``.
     """
+    from bluefog_tpu.timing import settle
+
     out = []
     t1 = None
-    # One compiled gather reused everywhere: a fresh jit inside the timed
-    # window would put trace+compile time into ms_per_step.
-    take = jax.jit(lambda t: t.ravel()[0])
-
-    def settle(res):
-        return np.asarray(take(jax.tree_util.tree_leaves(res)[0]))
-
     for n in ns:
         mesh = _mesh(n)
         fn, args = make_step(mesh)

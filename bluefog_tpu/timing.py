@@ -1,0 +1,29 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Tunnel-safe measurement helpers shared by bench.py, tools/, and
+:mod:`bluefog_tpu.scaling`.
+
+On remote-tunneled PJRT platforms ``block_until_ready`` can return before
+device completion, and ``np.asarray`` on an output caches its host value
+on the array object (so a second readback of the same object measures
+~0 — the artifact that under-reported the round-3 benchmark by ~25 %).
+:func:`settle` is the one correct synchronization point: a tiny jitted
+gather producing a FRESH scalar device array each call, then one host
+transfer.
+"""
+
+__all__ = ["settle"]
+
+_TAKE = None
+
+
+def settle(x) -> float:
+    """Block until ``x`` (any array, or a pytree's leaf) is computed, by
+    reading one element back through a fresh jitted gather; returns it."""
+    import numpy as np
+    import jax
+
+    global _TAKE
+    if _TAKE is None:
+        _TAKE = jax.jit(lambda t: t.ravel()[0])
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(_TAKE(leaf)))

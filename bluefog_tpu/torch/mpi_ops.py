@@ -15,6 +15,7 @@ from typing import List
 import numpy as np
 import torch
 
+import jax
 import ml_dtypes
 
 from bluefog_tpu import context as ctx_mod
@@ -31,8 +32,6 @@ def to_numpy(t: torch.Tensor) -> np.ndarray:
     (the common case: step counters, BatchNorm ``num_batches_tracked``)
     are narrowed losslessly; out-of-range int64 and float64 (silent
     precision loss) are rejected rather than corrupted."""
-    import jax
-
     x64 = jax.config.jax_enable_x64
     if t.dtype == torch.int64 and not x64:
         if t.numel() and (
@@ -79,8 +78,6 @@ class _Allreduce(torch.autograd.Function):
     def forward(ctx, t, average):
         ctx.average = average
         if t.dtype == torch.int64 and not average and t.numel():
-            import jax
-
             if not jax.config.jax_enable_x64:
                 size = ctx_mod.get_context().size
                 if t.abs().max().item() * size > 2**31 - 1:

@@ -25,19 +25,7 @@ import jax
 import jax.numpy as jnp
 
 
-_TAKE = None
-
-
-def _settle(out):
-    """block_until_ready is a no-op on remote-tunneled platforms; a host
-    readback of one element provably waits for the whole program. The
-    gather is one jitted fn (cached per aval) so settling never pays a
-    fresh trace/compile inside a timed region."""
-    global _TAKE
-    if _TAKE is None:
-        _TAKE = jax.jit(lambda t: t.ravel()[0])
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    return float(np.asarray(_TAKE(leaf)))
+from bluefog_tpu.timing import settle as _settle  # tunnel-safe sync
 
 
 def timed(fn, *args, iters=10, warmup=3):
