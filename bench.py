@@ -47,13 +47,10 @@ def _peak_flops(device) -> float:
     return 0.0
 
 
-def _settle(x):
-    """Tunnel-safe sync point (see bluefog_tpu.timing.settle: a plain
-    np.asarray readback would cache on the array object and break the
-    readback-latency correction — the round-3 ~25% under-report)."""
-    from bluefog_tpu.timing import settle
-
-    return settle(x)
+# Tunnel-safe sync point (a plain np.asarray readback would cache on the
+# array object and break the readback-latency correction — the round-3
+# ~25% under-report).
+from bluefog_tpu.timing import settle as _settle  # noqa: E402
 
 
 def run_headline() -> int:

@@ -25,7 +25,12 @@ import jax
 import jax.numpy as jnp
 
 
-from bluefog_tpu.timing import settle as _settle  # tunnel-safe sync
+def _settle(out):
+    """Tunnel-safe sync (bluefog_tpu.timing.settle), imported lazily so
+    the probe stays runnable with only jax+numpy installed."""
+    from bluefog_tpu.timing import settle
+
+    return settle(out)
 
 
 def timed(fn, *args, iters=10, warmup=3):
