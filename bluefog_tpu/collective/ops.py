@@ -349,15 +349,17 @@ def neighbor_allreduce_nonblocking(
     ctx = ctx_mod.get_context()
     x = _check_worker_array(ctx, x)
     plan = _resolve_plan(ctx, self_weight, src_weights, dst_weights, enable_topo_check)
-    if compression not in (None, "int8"):
+    if compression not in (None, "int8", "bf16"):
         raise ValueError(
-            f"compression must be None or 'int8', got {compression!r}"
+            "compression must be None, 'int8', or 'bf16', got "
+            f"{compression!r}"
         )
-    combine = (
-        inner.weighted_combine_quantized
-        if compression == "int8"
-        else inner.neighbor_allreduce
-    )
+    if compression is None:
+        combine = inner.neighbor_allreduce
+    else:
+        combine = lambda xb, pl_, ax: inner.weighted_combine_quantized(
+            xb, pl_, ax, wire=compression
+        )
     fn = _compiled(
         ctx, "neighbor_allreduce", (plan, compression) + _aval_key(x),
         lambda xb: combine(xb, plan, ctx_mod.WORKER_AXIS),
@@ -381,9 +383,10 @@ def neighbor_allreduce(
     ``mpi_ops.cc:99-164``; exchange ``mpi_controller.cc:419-551``.
 
     ``compression='int8'`` quantizes the wire payload (4x fewer gossip
-    bytes, bounded rounding error; see
+    bytes, bounded rounding error) and ``'bf16'`` halves it
+    near-losslessly (see
     :func:`bluefog_tpu.collective.inner.weighted_combine_quantized`) —
-    a capability the reference does not have.
+    capabilities the reference does not have.
     """
     return synchronize(
         neighbor_allreduce_nonblocking(
