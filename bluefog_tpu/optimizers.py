@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import optax
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
 from bluefog_tpu.collective import inner, ops as col_ops
 from bluefog_tpu.collective.plan import SchedulePlan, plan_from_topology
@@ -124,6 +125,19 @@ def _aval_key(tree):
         (tuple(l.shape), str(l.dtype))
         for l in jax.tree_util.tree_leaves(tree)
     ) + (str(jax.tree_util.tree_structure(tree)),)
+
+
+def _timed_dispatch(name, fn, *args):
+    """ENQUEUE-span dispatch, the analogue of the reference's optimizer
+    timeline hooks (torch/optimizers.py:112-165); same plumbing as the
+    eager facade's `_compiled` wrapper (collective/ops.py)."""
+    if not tl.timeline_enabled():
+        return fn(*args)
+    t0 = tl.timeline_now_us()
+    out = fn(*args)
+    tl.timeline_record_complete(name, "ENQUEUE", t0,
+                                tl.timeline_now_us() - t0)
+    return out
 
 
 _opt_uid = itertools.count()
@@ -446,7 +460,9 @@ class _GossipOptimizer:
             ctx.op_cache[key] = fn
         step_idx = jnp.asarray([self._step_count], jnp.int32)
         self._step_count += 1
-        return fn(params, opt_state, grads, step_idx, wops)
+        return _timed_dispatch(
+            "optimizer_step", fn, params, opt_state, grads, step_idx, wops
+        )
 
 
 def DistributedGradientAllreduceOptimizer(base_optimizer):
@@ -816,7 +832,8 @@ class _WindowOptimizer:
         (
             win.value, win.buffers, win.versions, win.p, win.p_buffers,
             params_out, opt_state,
-        ) = fn(
+        ) = _timed_dispatch(
+            "window_optimizer_step", fn,
             win.value, win.buffers, win.versions, win.p, win.p_buffers,
             opt_state, grads, wops,
         )

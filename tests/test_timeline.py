@@ -12,6 +12,8 @@ import time
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 import bluefog_tpu as bf
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
@@ -110,3 +112,29 @@ def test_watchdog_quiet_when_fast(caplog):
         assert not caplog.records
     finally:
         bf.logger.propagate = False
+
+
+def test_optimizer_steps_record_spans(tmp_path, cpu_devices):
+    """Optimizer dispatches appear in the trace — the analogue of the
+    reference's optimizer timeline hooks (torch/optimizers.py:112-165)."""
+    import optax
+
+    path = str(tmp_path / "opt_trace.json")
+    assert bf.timeline_init(path)
+    try:
+        c = np.random.RandomState(0).randn(SIZE, 3).astype(np.float32)
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+        params = {"w": bf.worker_values(lambda r: c[r])}
+        state = opt.init(params)
+        params, state = opt.step(
+            params, state, {"w": params["w"] - jnp.asarray(c)}
+        )
+        wopt = bf.DistributedWinPutOptimizer(optax.sgd(0.1))
+        wstate = wopt.init(params)
+        wopt.step(wstate, {"w": params["w"] - jnp.asarray(c)})
+        wopt.free()
+    finally:
+        assert bf.timeline_shutdown()
+    names = {e.get("name") for e in json.load(open(path))}
+    assert "optimizer_step" in names, names
+    assert "window_optimizer_step" in names, names
