@@ -67,6 +67,21 @@ final = float(np.asarray(loss_fn(params["w"], c.mean(0))))
 # residual; 5x loss reduction proves communication is really averaging
 # across the two OS processes (local-only SGD would stay at `start`).
 assert final < 0.2 * start, (start, final)
+
+# hierarchical across REAL machine boundaries: machine = controller
+# process, intra-machine psum on each host's devices, machine-level
+# gossip across the process boundary
+import bluefog_tpu.topology as tu
+bf.set_machine_topology(tu.RingGraph(2))
+hopt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(optax.sgd(0.4))
+hparams = {"w": jnp.asarray(c)}
+hstate = hopt.init(hparams)
+for _ in range(40):
+    hgrads = {"w": grad_fn(hparams["w"], c)}
+    hparams, hstate = hopt.step(hparams, hstate, hgrads)
+hfinal = float(np.asarray(loss_fn(hparams["w"], c.mean(0))))
+assert hfinal < 0.2 * start, (start, hfinal)
+
 bf.shutdown()
 print("MP_OK", jax.process_index(), start, final, flush=True)
 """
