@@ -138,3 +138,21 @@ def test_optimizer_steps_record_spans(tmp_path, cpu_devices):
     names = {e.get("name") for e in json.load(open(path))}
     assert "optimizer_step" in names, names
     assert "window_optimizer_step" in names, names
+
+
+def test_profiler_tier(tmp_path, cpu_devices):
+    """timeline_init(profiler=True) brackets the session with
+    jax.profiler.start_trace: device-side traces land next to the host
+    JSON (the reference has no device tier; its C++ phases were the
+    device story)."""
+    path = str(tmp_path / "trace.json")
+    assert bf.timeline_init(path, profiler=True)
+    bf.allreduce(bf.worker_values(np.float32(1)))
+    assert bf.timeline_shutdown()
+    prof_dir = path + ".xplane"
+    assert os.path.isdir(prof_dir), os.listdir(str(tmp_path))
+    # jax writes <dir>/plugins/profile/<ts>/*.xplane.pb
+    found = [
+        f for _root, _dirs, files in os.walk(prof_dir) for f in files
+    ]
+    assert any(f.endswith(".xplane.pb") for f in found), found
