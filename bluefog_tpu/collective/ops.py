@@ -336,6 +336,22 @@ def broadcast(x, root_rank: int, name: Optional[str] = None):
 # -- neighbor collectives ----------------------------------------------------
 
 
+def _combine_for(compression):
+    """Validate the compression knob and return the matching combine body
+    (shared by the eager facade and the torch frontend, so the validation
+    and wire selection cannot drift apart)."""
+    if compression not in (None, "int8", "bf16"):
+        raise ValueError(
+            "compression must be None, 'int8', or 'bf16', got "
+            f"{compression!r}"
+        )
+    if compression is None:
+        return inner.neighbor_allreduce
+    return lambda xb, pl_, ax: inner.weighted_combine_quantized(
+        xb, pl_, ax, wire=compression
+    )
+
+
 def neighbor_allreduce_nonblocking(
     x,
     *,
@@ -349,17 +365,7 @@ def neighbor_allreduce_nonblocking(
     ctx = ctx_mod.get_context()
     x = _check_worker_array(ctx, x)
     plan = _resolve_plan(ctx, self_weight, src_weights, dst_weights, enable_topo_check)
-    if compression not in (None, "int8", "bf16"):
-        raise ValueError(
-            "compression must be None, 'int8', or 'bf16', got "
-            f"{compression!r}"
-        )
-    if compression is None:
-        combine = inner.neighbor_allreduce
-    else:
-        combine = lambda xb, pl_, ax: inner.weighted_combine_quantized(
-            xb, pl_, ax, wire=compression
-        )
+    combine = _combine_for(compression)
     fn = _compiled(
         ctx, "neighbor_allreduce", (plan, compression) + _aval_key(x),
         lambda xb: combine(xb, plan, ctx_mod.WORKER_AXIS),

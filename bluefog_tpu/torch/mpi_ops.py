@@ -129,18 +129,18 @@ def broadcast(t: torch.Tensor, root_rank: int) -> torch.Tensor:
     return _Broadcast.apply(t, root_rank)
 
 
-def _combine_with_plan(np_arr: np.ndarray, plan):
+def _combine_with_plan(np_arr: np.ndarray, plan, compression=None):
     """Validated, timeline-instrumented combine over an explicit plan
     (one plan resolution; forward and backward share this path)."""
     rt_ctx = ctx_mod.get_context()
     arr = col_ops._check_worker_array(rt_ctx, np_arr)
+    body = col_ops._combine_for(compression)  # validates up front too
+    combine = lambda xb: body(xb, plan, ctx_mod.WORKER_AXIS)
     fn = col_ops._compiled(
         rt_ctx,
         "neighbor_allreduce",
-        (plan,) + col_ops._aval_key(arr),
-        lambda xb: col_ops.inner.neighbor_allreduce(
-            xb, plan, ctx_mod.WORKER_AXIS
-        ),
+        (plan, compression) + col_ops._aval_key(arr),
+        combine,
         in_specs=col_ops.P(ctx_mod.WORKER_AXIS),
         out_specs=col_ops.P(ctx_mod.WORKER_AXIS),
     )
@@ -150,7 +150,7 @@ def _combine_with_plan(np_arr: np.ndarray, plan):
 class _NeighborAllreduce(torch.autograd.Function):
     @staticmethod
     def forward(ctx, t, self_weight, src_weights, dst_weights,
-                enable_topo_check):
+                enable_topo_check, compression):
         rt_ctx = ctx_mod.get_context()
         # Resolve once; backward transposes the same weights even if the
         # context topology changes between forward and backward. The dense
@@ -158,7 +158,9 @@ class _NeighborAllreduce(torch.autograd.Function):
         ctx.plan = col_ops._resolve_plan(
             rt_ctx, self_weight, src_weights, dst_weights, enable_topo_check
         )
-        return from_numpy(_combine_with_plan(to_numpy(t), ctx.plan))
+        return from_numpy(
+            _combine_with_plan(to_numpy(t), ctx.plan, compression)
+        )
 
     @staticmethod
     def backward(ctx, grad):
@@ -167,8 +169,10 @@ class _NeighborAllreduce(torch.autograd.Function):
         from bluefog_tpu.collective.plan import plan_from_matrix
 
         plan_t = plan_from_matrix(ctx.plan.weight_matrix().T)
+        # adjoint runs full precision: quantizing gradients would bias
+        # training beyond the forward's bounded rounding error
         g = _combine_with_plan(to_numpy(grad), plan_t)
-        return from_numpy(g), None, None, None, None
+        return from_numpy(g), None, None, None, None, None
 
 
 def neighbor_allreduce(
@@ -178,11 +182,15 @@ def neighbor_allreduce(
     src_weights=None,
     dst_weights=None,
     enable_topo_check: bool = True,
+    compression=None,
 ) -> torch.Tensor:
     """Weighted neighbor combine per the active (or explicit) topology;
-    differentiable (adjoint = transposed-weight combine)."""
+    differentiable (adjoint = transposed-weight combine, always full
+    precision). ``compression='int8'|'bf16'`` quantizes the forward wire
+    (see :func:`bluefog_tpu.collective.ops.neighbor_allreduce`)."""
     return _NeighborAllreduce.apply(
-        t, self_weight, src_weights, dst_weights, enable_topo_check
+        t, self_weight, src_weights, dst_weights, enable_topo_check,
+        compression,
     )
 
 

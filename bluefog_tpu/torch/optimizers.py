@@ -105,6 +105,7 @@ def DistributedNeighborAllreduceOptimizer(optimizer: torch.optim.Optimizer):
                     src_weights=self.src_weights,
                     dst_weights=self.dst_weights,
                     enable_topo_check=self.enable_topo_check,
+                    compression=self.compression,
                 )
             )
 
@@ -115,6 +116,7 @@ def DistributedNeighborAllreduceOptimizer(optimizer: torch.optim.Optimizer):
     opt.src_weights = None
     opt.dst_weights = None
     opt.enable_topo_check = True
+    opt.compression = None
     return opt
 
 

@@ -345,3 +345,36 @@ def test_neighbor_optimizer_dynamic_topology_idiom():
     w = p.data.numpy()
     assert np.abs(w - w.mean(0)).max() < 0.25
     assert np.abs(w.mean(0) - c.mean(0)).max() < 0.1
+
+
+def test_neighbor_allreduce_compression():
+    """The torch frontend exposes the compressed gossip wire; adjoints
+    stay full precision."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = torch.randn(SIZE, 64)
+    exact = bft.neighbor_allreduce(x)
+    for comp, tol in (("bf16", 0.02), ("int8", 0.05)):
+        out = bft.neighbor_allreduce(x, compression=comp)
+        assert (out - exact).abs().max().item() < tol, comp
+    xg = x.clone().requires_grad_(True)
+    bft.neighbor_allreduce(xg, compression="int8").sum().backward()
+    assert torch.isfinite(xg.grad).all()
+
+    c, p = quad_problem(13)
+    opt = bft.DistributedNeighborAllreduceOptimizer(
+        torch.optim.SGD([p], lr=0.1)
+    )
+    opt.compression = "int8"
+    for _ in range(40):
+        opt.zero_grad()
+        (0.5 * ((p - torch.from_numpy(c)) ** 2).sum()).backward()
+        opt.step()
+        opt.param_groups[0]["lr"] *= 0.95
+    w = p.data.numpy()
+    assert np.abs(w - w.mean(0)).max() < 0.25
+
+
+def test_compression_validated_at_torch_boundary():
+    x = torch.randn(SIZE, 4)
+    with pytest.raises(ValueError, match="compression must be"):
+        bft.neighbor_allreduce(x, compression="fp16")
