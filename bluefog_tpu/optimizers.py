@@ -81,6 +81,15 @@ def _tree_block(tree):
     return jax.tree_util.tree_map(lambda t: t[0], tree)
 
 
+def _dtype_groups(leaves):
+    """Deterministic (dtype-sorted) same-dtype leaf groups:
+    [(dtype_str, [leaf_idx...])]."""
+    groups: dict = {}
+    for i, l in enumerate(leaves):
+        groups.setdefault(str(jnp.result_type(l)), []).append(i)
+    return sorted(groups.items())
+
+
 def _packed_gossip(tree, gossip_fn, step, wops):
     """Apply a gossip combine to a whole pytree with ONE wire payload per
     dtype group per round.
@@ -97,11 +106,8 @@ def _packed_gossip(tree, gossip_fn, step, wops):
     intact — bf16 leaves gossip in bf16, never promoted by packing.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    groups: dict = {}
-    for i, l in enumerate(leaves):
-        groups.setdefault(jnp.result_type(l), []).append(i)
     out = [None] * len(leaves)
-    for _dt, idxs in groups.items():
+    for _dt, idxs in _dtype_groups(leaves):
         if len(idxs) == 1:
             i = idxs[0]
             out[i] = gossip_fn(leaves[i], step, wops)
@@ -114,6 +120,25 @@ def _packed_gossip(tree, gossip_fn, step, wops):
             out[i] = res[off:off + n].reshape(leaves[i].shape)
             off += n
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _packed_gossip_ef(tree, ef_blocks, ef_combine):
+    """Like :func:`_packed_gossip` but with sender error-feedback state:
+    one f32 residual vector per dtype group, threaded through the combine
+    (``ef_combine(flat, e) -> (y, e_new)``). Returns (tree', ef')."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = [None] * len(leaves)
+    ef_out = []
+    for gi, (_dt, idxs) in enumerate(_dtype_groups(leaves)):
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        y, e_new = ef_combine(flat, ef_blocks[gi])
+        ef_out.append(e_new)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = y[off:off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out), tuple(ef_out)
 
 
 def _tree_restack(tree):
@@ -238,24 +263,6 @@ class _GossipOptimizer:
                 "neighbor_allreduce or hierarchical communication; "
                 f"this optimizer uses {comm.value!r}"
             )
-        if self.compression is not None:
-            # validate centrally: a silently-ignored knob would make the
-            # user believe wire bytes dropped 4x when nothing changed
-            if self.compression not in ("int8", "bf16"):
-                raise ValueError(
-                    "compression must be None, 'int8', or 'bf16', got "
-                    f"{self.compression!r}"
-                )
-            if comm not in (
-                CommunicationType.neighbor_allreduce,
-                CommunicationType.hierarchical_neighbor_allreduce,
-            ) or self.schedule is not None:
-                raise ValueError(
-                    f"compression={self.compression!r} is only supported "
-                    "on the static-plan neighbor_allreduce and "
-                    "hierarchical paths (not schedules, allreduce, or "
-                    "empty communication)"
-                )
         if comm == CommunicationType.empty:
             return ("empty",), (lambda t, step, wops: t), ()
         if comm == CommunicationType.allreduce:
@@ -298,6 +305,17 @@ class _GossipOptimizer:
                 # per-step varying weights reuse one compiled program,
                 # same guarantee as the exact path
                 wire = self.compression
+                if wire == "int8_ef":
+                    return (
+                        ("na_q_ef", perms),
+                        lambda flat, e, wops: (
+                            inner.weighted_combine_quantized_ef_operands(
+                                flat, e, perms, wops[0],
+                                ctx_mod.WORKER_AXIS,
+                            )
+                        ),
+                        (jnp.asarray(recv_w),),
+                    )
                 return (
                     ("na_q", wire, perms),
                     lambda t, step, wops: (
@@ -316,6 +334,38 @@ class _GossipOptimizer:
                 (jnp.asarray(self_w), jnp.asarray(recv_w)),
             )
         raise AssertionError(comm)
+
+    def _validate_compression(self):
+        """Central knob validation for BOTH the flat and hierarchical
+        paths: a silently-ignored or trace-time-erroring knob would make
+        the user believe wire bytes dropped when nothing changed."""
+        if self.compression is None:
+            return
+        comm = self.communication_type
+        if self.compression not in ("int8", "bf16", "int8_ef"):
+            raise ValueError(
+                "compression must be None, 'int8', 'bf16', or "
+                f"'int8_ef', got {self.compression!r}"
+            )
+        if self.compression == "int8_ef" and (
+            comm != CommunicationType.neighbor_allreduce
+            or self.schedule is not None
+        ):
+            raise ValueError(
+                "compression='int8_ef' (error feedback carries "
+                "per-worker state) is only supported on the "
+                "static-plan neighbor_allreduce path"
+            )
+        if comm not in (
+            CommunicationType.neighbor_allreduce,
+            CommunicationType.hierarchical_neighbor_allreduce,
+        ) or self.schedule is not None:
+            raise ValueError(
+                f"compression={self.compression!r} is only supported "
+                "on the static-plan neighbor_allreduce and "
+                "hierarchical paths (not schedules, allreduce, or "
+                "empty communication)"
+            )
 
     def _hier_key_and_fn(self, ctx):
         """Hierarchical communication: static machine plan (operand
@@ -395,6 +445,42 @@ class _GossipOptimizer:
             ctx.op_cache[key] = plan
         return plan
 
+    # -- error-feedback state (compression='int8_ef') ------------------------
+
+    def _ensure_ef_state(self, ctx, params, spec, perms):
+        """Per-dtype-group CHOCO copies (x_hat_self, x_hat_recv),
+        worker-stacked f32; rebuilt (zeroed) whenever the parameter avals
+        OR the communication structure change — x_hat_recv[r] integrates
+        round-r's fixed source, so a new edge set invalidates every copy
+        (stale copies would break the bit-identical-replica invariant;
+        zeroed copies merely re-transmit full magnitude a few rounds)."""
+        from jax.sharding import NamedSharding
+
+        leaves = jax.tree_util.tree_leaves(params)
+        sig = (
+            tuple(
+                (dt, sum(int(np.prod(leaves[i].shape[1:])) for i in idxs))
+                for dt, idxs in _dtype_groups(leaves)
+            ),
+            perms,
+        )
+        if getattr(self, "_ef_sig", None) == sig:
+            return
+        n_rounds = len(perms)
+        sharding = NamedSharding(ctx.mesh, spec)
+        self._ef = tuple(
+            (
+                jax.device_put(
+                    np.zeros((ctx.size, d), np.float32), sharding
+                ),
+                jax.device_put(
+                    np.zeros((ctx.size, n_rounds, d), np.float32), sharding
+                ),
+            )
+            for _dt, d in sig[0]
+        )
+        self._ef_sig = sig
+
     # -- the step ------------------------------------------------------------
 
     def step(self, params, opt_state, grads):
@@ -404,6 +490,7 @@ class _GossipOptimizer:
         across hooks + synchronize + inner step, optimizers.py:362-482).
         """
         ctx = ctx_mod.get_context()
+        self._validate_compression()
         hier = (
             self.communication_type
             == CommunicationType.hierarchical_neighbor_allreduce
@@ -416,20 +503,24 @@ class _GossipOptimizer:
             gossip_key, gossip_fn, wops = self._gossip_key_and_fn(ctx)
             mesh = ctx.mesh
             spec = P(ctx_mod.WORKER_AXIS)
+        ef = not hier and self.compression == "int8_ef"
+        if ef:
+            self._ensure_ef_state(ctx, params, spec, gossip_key[1])
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
-            self._tx_version,
+            self._tx_version, ef,
         ) + tuple(gossip_key) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
             order = self.order
             tx = self._tx
 
-            def body(params_b, state_b, grads_b, step, wops):
+            def body(params_b, state_b, grads_b, step, wops, ef_b):
                 p = _tree_block(params_b)
                 s = _tree_block(state_b)
                 g = _tree_block(grads_b)
                 step = step[0]
+                ef_out = ef_b
                 if order == "grad":
                     # order='grad' only exists with allreduce communication
                     # (DistributedGradientAllreduceOptimizer)
@@ -441,28 +532,50 @@ class _GossipOptimizer:
                         step,
                         wops,
                     )
+
+                def communicate(tree, ef_state):
+                    if ef:
+                        return _packed_gossip_ef(
+                            tree,
+                            tuple(
+                                (sb[0], rb[0]) for sb, rb in ef_state
+                            ),
+                            lambda flat, e: gossip_fn(flat, e, wops),
+                        )
+                    return _packed_gossip(tree, gossip_fn, step, wops), ef_state
+
                 if order == "cta":
-                    p = _packed_gossip(p, gossip_fn, step, wops)
+                    p, ef_out = communicate(p, ef_out)
                 updates, s = tx.update(g, s, p)
                 p = optax.apply_updates(p, updates)
                 if order == "atc":
-                    p = _packed_gossip(p, gossip_fn, step, wops)
-                return _tree_restack(p), _tree_restack(s)
+                    p, ef_out = communicate(p, ef_out)
+                if ef:
+                    ef_out = tuple(
+                        (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
+                        for sb, rb in ef_out
+                    )
+                return _tree_restack(p), _tree_restack(s), ef_out
 
             fn = jax.jit(
                 jax.shard_map(
                     body,
                     mesh=mesh,
-                    in_specs=(spec, spec, spec, P(), P()),
-                    out_specs=(spec, spec),
+                    in_specs=(spec, spec, spec, P(), P(), spec),
+                    out_specs=(spec, spec, spec),
                 )
             )
             ctx.op_cache[key] = fn
         step_idx = jnp.asarray([self._step_count], jnp.int32)
         self._step_count += 1
-        return _timed_dispatch(
-            "optimizer_step", fn, params, opt_state, grads, step_idx, wops
+        ef_in = self._ef if ef else ()
+        params_out, opt_state, ef_out = _timed_dispatch(
+            "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
+            ef_in,
         )
+        if ef:
+            self._ef = ef_out
+        return params_out, opt_state
 
 
 def DistributedGradientAllreduceOptimizer(base_optimizer):

@@ -276,3 +276,94 @@ def test_unknown_wire_raises_in_inner():
             jnp.ones((4,)), (), jnp.zeros((0, SIZE)), "workers",
             wire="fp4",
         )
+
+
+def test_error_feedback_removes_constant_lr_noise_floor():
+    """Plain int8 gossip stalls at a quantization noise floor; error
+    feedback (int8_ef) keeps shrinking the consensus residual — the
+    reason the EF variant exists. Pure consensus (zero gradients)
+    isolates the floor from the CTA constant-lr bias."""
+    c = np.random.RandomState(7).randn(SIZE, 64).astype(np.float32) * 5.0
+    zero = {"w": jnp.zeros((SIZE, 64), jnp.float32)}
+
+    def run(compression):
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+        opt.compression = compression
+        params = {"w": bf.worker_values(lambda r: c[r])}
+        state = opt.init(params)
+        for _ in range(150):
+            params, state = opt.step(params, state, zero)
+        w = np.asarray(params["w"])
+        return np.abs(w - w.mean(0)).max()
+
+    spread_plain = run("int8")
+    spread_ef = run("int8_ef")
+    assert spread_ef < 0.1 * spread_plain, (spread_plain, spread_ef)
+    assert spread_ef < 1e-3
+
+
+def test_error_feedback_single_program():
+    ctx = bf.get_context()
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    opt.compression = "int8_ef"
+    c = np.random.RandomState(8).randn(SIZE, 6).astype(np.float32)
+    params = {"a": bf.worker_values(lambda r: c[r, :3]),
+              "b": bf.worker_values(lambda r: c[r, 3:])}
+    state = opt.init(params)
+    before = None
+    for i in range(5):
+        params, state = opt.step(params, state,
+                                 {"a": params["a"], "b": params["b"]})
+        if i == 0:
+            before = len(ctx.op_cache)
+    assert len(ctx.op_cache) == before
+
+
+def test_error_feedback_restricted_paths():
+    opt = bf.DistributedAllreduceOptimizer(optax.sgd(0.1))
+    opt.compression = "int8_ef"
+    params = {"w": bf.worker_values(lambda r: np.ones(4, np.float32))}
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="int8_ef"):
+        opt.step(params, state, params)
+
+
+def test_ef_state_resets_on_topology_change():
+    """Dynamic weight reassignment changes the per-round sources; stale
+    CHOCO copies would break the bit-identical-replica invariant, so the
+    EF state must be rebuilt (and training stays correct through the
+    change)."""
+    c = np.random.RandomState(9).randn(SIZE, 16).astype(np.float32)
+    zero = {"w": jnp.zeros((SIZE, 16), jnp.float32)}
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    opt.compression = "int8_ef"
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for _ in range(10):
+        params, state = opt.step(params, state, zero)
+    ef_before = opt._ef
+    # move to a ring (different edge set, 2 rounds)
+    opt.self_weight = 1.0 / 3.0
+    opt.src_weights = [
+        {(r - 1) % SIZE: 1 / 3, (r + 1) % SIZE: 1 / 3} for r in range(SIZE)
+    ]
+    opt.dst_weights = [[(r - 1) % SIZE, (r + 1) % SIZE] for r in range(SIZE)]
+    for _ in range(60):
+        params, state = opt.step(params, state, zero)
+    assert opt._ef is not ef_before  # rebuilt for the new structure
+    w = np.asarray(params["w"])
+    np.testing.assert_allclose(w, np.tile(c.mean(0), (SIZE, 1)), atol=5e-3)
+
+
+def test_hierarchical_rejects_int8_ef(cpu_devices):
+    bf.shutdown()
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=4)
+    bf.set_machine_topology(tu.RingGraph(2))
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(0.1)
+    )
+    opt.compression = "int8_ef"
+    params = {"w": bf.worker_values(lambda r: np.ones(4, np.float32))}
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="int8_ef"):
+        opt.step(params, state, params)

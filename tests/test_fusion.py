@@ -49,7 +49,7 @@ def _compiled_step_hlo(opt, params, state, grads):
     assert len(fns) == 1
     return (
         fns[0]
-        .lower(params, state, grads, step_idx, wops)
+        .lower(params, state, grads, step_idx, wops, ())
         .compile()
         .as_text()
     )
