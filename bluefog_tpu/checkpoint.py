@@ -88,6 +88,17 @@ def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
         wstate = _window_state(optimizer)
         if wstate is not None:
             payload["window"] = wstate
+        ef = getattr(optimizer, "_ef", None)
+        if ef is not None:
+            # CHOCO compression copies (int8_ef): without them a resumed
+            # run would re-zero consistently (safe but briefly
+            # full-magnitude); with them the resume is bit-compatible.
+            # The signature (dtype groups + perms) rides along so restore
+            # can install state the optimizer itself validates.
+            payload["ef_state"] = [
+                [np.asarray(a) for a in pair] for pair in ef
+            ]
+            payload["ef_sig"] = repr(optimizer._ef_sig)
     _checkpointer().save(target, payload, force=True)
     return target
 
@@ -157,4 +168,27 @@ def restore(path: str, step: Optional[int] = None,
                     jax.device_put(saved.astype(cur.dtype),
                                    win_mod._worker_sharding(ctx)),
                 )
+        ef_saved = payload.get("ef_state")
+        if ef_saved is not None:
+            # install state AND its signature unconditionally (no live
+            # _ef needed): the optimizer's own _ensure_ef_state compares
+            # the signature against the runtime params/topology on the
+            # next step and zero-rebuilds on any mismatch — so a
+            # checkpoint from a different edge set can never install
+            # stale replica copies, and a matching one resumes
+            # bit-compatibly even before the first step
+            import ast
+
+            ctx = ctx_mod.get_context()
+            sharding = win_mod._worker_sharding(ctx)
+            optimizer._ef = tuple(
+                tuple(
+                    jax.device_put(
+                        np.asarray(sv, np.float32), sharding
+                    )
+                    for sv in pair
+                )
+                for pair in ef_saved
+            )
+            optimizer._ef_sig = ast.literal_eval(payload["ef_sig"])
     return int(payload["step"]), payload["params"], payload["opt_state"]
