@@ -292,3 +292,20 @@ def test_gqa_ulysses_invalid_group_raises_at_entry():
             )[None],
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
         )(q, kv, kv)
+
+
+def test_facade_caches_compiled_program():
+    """Repeated ring/Ulysses facade calls with the same avals reuse ONE
+    compiled program (the op_cache contract every eager op follows)."""
+    ctx = bf.get_context()
+    _, (qs, ks, vs) = qkv(9)
+    args = [jnp.asarray(np.asarray(a)) for a in (qs, ks, vs)]
+    ring_attention(*args, causal=True)
+    before = len(ctx.op_cache)
+    for _ in range(3):
+        ring_attention(*args, causal=True)
+    assert len(ctx.op_cache) == before
+    ulysses_attention(*args, causal=True)
+    after_u = len(ctx.op_cache)
+    ulysses_attention(*args, causal=True)
+    assert len(ctx.op_cache) == after_u
