@@ -14,6 +14,7 @@ run() {
 run average_consensus.py
 run decentralized_optimization.py
 run long_context.py
+run checkpoint_resume.py
 run mnist.py --dist-optimizer neighbor_allreduce --epochs 80
 run mnist.py --dist-optimizer gradient_allreduce --epochs 80
 run mnist.py --dist-optimizer win_put --epochs 80

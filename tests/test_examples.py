@@ -67,3 +67,9 @@ def test_benchmark_static_and_dynamic():
 def test_long_context():
     out = run_example("long_context.py")
     assert "PASSED" in out
+
+
+@pytest.mark.example
+def test_checkpoint_resume():
+    out = run_example("checkpoint_resume.py")
+    assert "PASSED" in out
