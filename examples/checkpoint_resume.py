@@ -9,6 +9,7 @@ finish — the resumed trajectory must match an uninterrupted run exactly,
 including the step counter that drives the dynamic schedule.
 """
 
+import shutil
 import sys
 import tempfile
 
@@ -68,6 +69,7 @@ def main() -> int:
     for _ in range(30 - step):
         p2, s2 = opt2.step(p2, s2, grads(p2))
 
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
     diff = float(np.abs(np.asarray(p2["w"]) - np.asarray(p_ref["w"])).max())
     loss = float(np.mean((np.asarray(p2["w"]) - c.mean(0)) ** 2))
     print(f"[resume] |resumed - uninterrupted| = {diff:.2e}, loss {loss:.4f}")
