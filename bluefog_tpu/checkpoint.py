@@ -85,6 +85,15 @@ def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
         counter = getattr(optimizer, "_step_count", None)
         if counter is not None:
             payload["opt_step_count"] = int(counter)
+        comm = getattr(optimizer, "_comm_count", None)
+        if comm is not None:
+            payload["opt_comm_count"] = int(comm)
+        accum = getattr(optimizer, "_grad_accum", None)
+        if accum is not None:
+            # mid-accumulation-cycle gradient sum (grad order with
+            # num_steps_per_communication > 1): without it a resume would
+            # silently drop the pending micro-batches
+            payload["grad_accum"] = _to_host(accum)
         wstate = _window_state(optimizer)
         if wstate is not None:
             payload["window"] = wstate
@@ -125,8 +134,24 @@ def restore(path: str, step: Optional[int] = None,
     target = os.path.join(os.path.abspath(path), str(int(step)))
     payload = _checkpointer().restore(target)
     if optimizer is not None:
+        wstate = payload.get("window")
+        from bluefog_tpu.optimizers import _WindowOptimizer
+
+        # window check first: it is the more specific refusal (window
+        # optimizers also carry a step counter now)
+        if wstate is None and isinstance(optimizer, _WindowOptimizer):
+            raise ValueError(
+                "checkpoint has no window state but the given optimizer is "
+                "a window optimizer; re-save with save(..., optimizer=opt)"
+            )
         if "opt_step_count" in payload:
             optimizer._step_count = int(payload["opt_step_count"])
+        elif wstate is not None:
+            # a window checkpoint from before window optimizers carried a
+            # step counter: it IS a complete optimizer save (window state
+            # proves `optimizer=` was passed); resume the counter at 0 —
+            # exact for the pre-knob K=1 semantics it was saved under
+            optimizer._step_count = 0
         elif getattr(optimizer, "_step_count", None) is not None:
             # the checkpoint was saved without `optimizer=`, so the
             # schedule-driving counter is absent; restoring silently would
@@ -136,14 +161,14 @@ def restore(path: str, step: Optional[int] = None,
                 "optimizer is step-indexed; re-save with "
                 "save(..., optimizer=opt)"
             )
-        wstate = payload.get("window")
-        from bluefog_tpu.optimizers import _WindowOptimizer
-
-        if wstate is None and isinstance(optimizer, _WindowOptimizer):
-            raise ValueError(
-                "checkpoint has no window state but the given optimizer is "
-                "a window optimizer; re-save with save(..., optimizer=opt)"
+        if getattr(optimizer, "_comm_count", None) is not None:
+            # pre-knob checkpoints (K=1 semantics) had comm == step
+            optimizer._comm_count = int(
+                payload.get("opt_comm_count",
+                            payload.get("opt_step_count", 0))
             )
+        if hasattr(optimizer, "_grad_accum"):
+            optimizer._grad_accum = payload.get("grad_accum")
         if wstate is not None:
             name = getattr(optimizer, "_name", None)
             if name is None:

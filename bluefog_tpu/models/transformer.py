@@ -48,7 +48,13 @@ class TransformerLM(nn.Module):
     attention; pass a sequence-parallel block function (closed over the
     mesh axis) to shard the sequence. Positions are GLOBAL: pass
     ``pos_offset`` = this worker's first token index so sequence-sharded
-    workers embed their true positions."""
+    workers embed their true positions.
+
+    Caveat: the out-of-range check below only fires for *static* int
+    offsets. A traced offset (e.g. computed from ``lax.axis_index`` inside
+    ``shard_map``) that pushes positions past ``max_len`` silently clamps
+    the position gather — ensure ``n_shards * block_len <= max_len`` at
+    call-site when the offset is traced."""
 
     vocab: int = 64
     dim: int = 32

@@ -175,7 +175,8 @@ class _GossipOptimizer:
     'atc' after, 'grad' gossips the *gradients* (allreduce-mean) instead.
     """
 
-    def __init__(self, base_optimizer, communication_type, order: str):
+    def __init__(self, base_optimizer, communication_type, order: str,
+                 num_steps_per_communication: int = 1):
         # Unique id for compiled-step cache keys: id(self.tx) is unsafe
         # (CPython reuses addresses after GC).
         self._uid = next(_opt_uid)
@@ -204,7 +205,14 @@ class _GossipOptimizer:
         # Hierarchical knobs (reference mpi_ops.py:648-821).
         self.neighbor_machine_weights = None
         self.send_neighbor_machines = None
+        # Communicate every K-th step() call (reference
+        # torch/optimizers.py:321): intermediate calls run the inner
+        # update purely locally (cta/atc) or accumulate gradients with no
+        # update at all (grad order — classic gradient accumulation).
+        self.num_steps_per_communication = num_steps_per_communication
         self._step_count = 0
+        self._comm_count = 0  # schedule index: advances per communication
+        self._grad_accum = None  # grad-order local accumulator (sum)
 
     @property
     def tx(self):
@@ -491,19 +499,44 @@ class _GossipOptimizer:
         """
         ctx = ctx_mod.get_context()
         self._validate_compression()
+        k = int(self.num_steps_per_communication)
+        if k < 1:
+            raise ValueError(
+                "num_steps_per_communication must be a positive int, got "
+                f"{self.num_steps_per_communication!r}"
+            )
+        comm_now = self._step_count % k == k - 1  # communicate on K-th call
+        if not comm_now and self.order == "grad":
+            # between communications, gradient order accumulates and leaves
+            # params/state untouched (reference _DistributedOptimizer's
+            # reduce-delay accumulation, optimizers.py:347,443)
+            self._step_count += 1
+            self._grad_accum = (
+                grads if self._grad_accum is None
+                else self._tree_add(ctx, self._grad_accum, grads)
+            )
+            return params, opt_state
         hier = (
             self.communication_type
             == CommunicationType.hierarchical_neighbor_allreduce
         )
         if hier:
-            gossip_key, gossip_fn, wops = self._hier_key_and_fn(ctx)
             mesh = ctx.machine_mesh
             spec = P((ctx_mod.MACHINE_AXIS, ctx_mod.LOCAL_AXIS))
         else:
-            gossip_key, gossip_fn, wops = self._gossip_key_and_fn(ctx)
             mesh = ctx.mesh
             spec = P(ctx_mod.WORKER_AXIS)
-        ef = not hier and self.compression == "int8_ef"
+        if not comm_now:
+            # between-communication cta/atc call: the SAME fused body, with
+            # the identity combine — a purely local inner update
+            gossip_key, gossip_fn, wops = (
+                ("local",), (lambda t, step, wops: t), ()
+            )
+        elif hier:
+            gossip_key, gossip_fn, wops = self._hier_key_and_fn(ctx)
+        else:
+            gossip_key, gossip_fn, wops = self._gossip_key_and_fn(ctx)
+        ef = comm_now and not hier and self.compression == "int8_ef"
         if ef:
             self._ensure_ef_state(ctx, params, spec, gossip_key[1])
         key = (
@@ -566,8 +599,15 @@ class _GossipOptimizer:
                 )
             )
             ctx.op_cache[key] = fn
-        step_idx = jnp.asarray([self._step_count], jnp.int32)
+        if comm_now and self.order == "grad" and self._grad_accum is not None:
+            grads = self._tree_add(ctx, self._grad_accum, grads)
+            self._grad_accum = None
+        # dynamic schedules advance per COMMUNICATION, not per call, so a
+        # K>1 optimizer still walks every topology in the schedule
+        step_idx = jnp.asarray([self._comm_count], jnp.int32)
         self._step_count += 1
+        if comm_now:
+            self._comm_count += 1
         ef_in = self._ef if ef else ()
         params_out, opt_state, ef_out = _timed_dispatch(
             "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
@@ -577,56 +617,82 @@ class _GossipOptimizer:
             self._ef = ef_out
         return params_out, opt_state
 
+    def _tree_add(self, ctx, a, b):
+        key = ("opt_tree_add", self._uid) + _aval_key(a)
+        fn = ctx.op_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda x, y: jax.tree_util.tree_map(jnp.add, x, y)
+            )
+            ctx.op_cache[key] = fn
+        return fn(a, b)
 
-def DistributedGradientAllreduceOptimizer(base_optimizer):
+def DistributedGradientAllreduceOptimizer(base_optimizer,
+                                          num_steps_per_communication=1):
     """Synchronous gradient averaging, Horovod-style
     (reference optimizers.py:166-295, factory :1376)."""
     return _GossipOptimizer(
-        base_optimizer, CommunicationType.allreduce, order="grad"
+        base_optimizer, CommunicationType.allreduce, order="grad",
+        num_steps_per_communication=num_steps_per_communication,
     )
 
 
-def DistributedAllreduceOptimizer(base_optimizer):
+def DistributedAllreduceOptimizer(base_optimizer,
+                                  num_steps_per_communication=1):
     """CTA with global weight averaging (reference :1301)."""
     return _GossipOptimizer(
-        base_optimizer, CommunicationType.allreduce, order="cta"
+        base_optimizer, CommunicationType.allreduce, order="cta",
+        num_steps_per_communication=num_steps_per_communication,
     )
 
 
-def DistributedNeighborAllreduceOptimizer(base_optimizer):
+def DistributedNeighborAllreduceOptimizer(base_optimizer,
+                                          num_steps_per_communication=1):
     """CTA with neighbor weight gossip — the flagship decentralized
     optimizer (reference :1326; algebra comment :311-318)."""
     return _GossipOptimizer(
-        base_optimizer, CommunicationType.neighbor_allreduce, order="cta"
+        base_optimizer, CommunicationType.neighbor_allreduce, order="cta",
+        num_steps_per_communication=num_steps_per_communication,
     )
 
 
-def DistributedHierarchicalNeighborAllreduceOptimizer(base_optimizer):
+def DistributedHierarchicalNeighborAllreduceOptimizer(
+    base_optimizer, num_steps_per_communication=1
+):
     """CTA with intra-machine average + machine-level gossip
     (reference :1352)."""
     return _GossipOptimizer(
         base_optimizer,
         CommunicationType.hierarchical_neighbor_allreduce,
         order="cta",
+        num_steps_per_communication=num_steps_per_communication,
     )
 
 
 def DistributedAdaptThenCombineOptimizer(
     base_optimizer,
     communication_type: CommunicationType = CommunicationType.neighbor_allreduce,
+    num_steps_per_communication=1,
 ):
     """ATC: local optax step first, then gossip the updated weights
     (reference :485-842, factory :1426 — its hand-written inner sgd/adam/
     rmsprop/adagrad/adadelta steps are any optax transformation here)."""
-    return _GossipOptimizer(base_optimizer, communication_type, order="atc")
+    return _GossipOptimizer(
+        base_optimizer, communication_type, order="atc",
+        num_steps_per_communication=num_steps_per_communication,
+    )
 
 
 def DistributedAdaptWithCombineOptimizer(
     base_optimizer,
     communication_type: CommunicationType = CommunicationType.neighbor_allreduce,
+    num_steps_per_communication=1,
 ):
     """CTA with selectable communication (reference :1497)."""
-    return _GossipOptimizer(base_optimizer, communication_type, order="cta")
+    return _GossipOptimizer(
+        base_optimizer, communication_type, order="cta",
+        num_steps_per_communication=num_steps_per_communication,
+    )
 
 
 # -- window-based (asynchronous-algorithm) optimizers ------------------------
@@ -649,7 +715,8 @@ class _WindowOptimizer:
     :func:`DistributedPushSumOptimizer`).
     """
 
-    def __init__(self, base_optimizer, mode: str, window_prefix=None):
+    def __init__(self, base_optimizer, mode: str, window_prefix=None,
+                 num_steps_per_communication: int = 1):
         self._uid = next(_opt_uid)  # compiled-step cache key component
         self._tx_version = 0
         self._tx = base_optimizer
@@ -658,6 +725,10 @@ class _WindowOptimizer:
         self.dst_weights = None
         self.src_weights = None
         self.force_barrier = False  # parity knob; barrier is implicit
+        # Exchange every K-th step() call; intermediate calls update the
+        # window value locally (reference optimizers.py:846,865-866).
+        self.num_steps_per_communication = num_steps_per_communication
+        self._step_count = 0
         if window_prefix is None:
             window_prefix = f"_wopt{self._uid}"
         self.prefix = window_prefix
@@ -844,6 +915,58 @@ class _WindowOptimizer:
         )
         return self_vec, w_recv, participating, False
 
+    def _local_step(self, ctx, win, axis, opt_state, grads):
+        """A between-communication call under num_steps_per_communication:
+        the inner update adapts the raw window value; no exchange, no
+        combine, buffers/versions/p untouched (reference
+        _DistributedWinOptimizer's delay gate, optimizers.py:866,1000)."""
+        key = (
+            "wopt_local_step", self._uid, self._tx_version,
+        ) + _aval_key((opt_state, grads))
+        fn = ctx.op_cache.get(key)
+        if fn is None:
+            push_sum = self.mode == "push_sum"
+            tx = self._tx
+
+            def body(value, p, s_b, g_b):
+                v, pv = value[0], p[0]
+                s = _tree_block(s_b)
+                g = _tree_block(g_b)
+                cur = jax.tree_util.tree_unflatten(
+                    self._treedef, self._unpack_block(v)
+                )
+                updates, s = tx.update(g, s, cur)
+                cur = optax.apply_updates(cur, updates)
+                xb = jnp.concatenate(
+                    [
+                        jnp.reshape(l, (-1,)).astype(self._pack_dtype)
+                        for l in jax.tree_util.tree_leaves(cur)
+                    ]
+                )
+                est = xb / pv.astype(xb.dtype) if push_sum else xb
+                out = jax.tree_util.tree_unflatten(
+                    self._treedef, self._unpack_block(est)
+                )
+                return (
+                    jnp.expand_dims(xb, 0),
+                    _tree_restack(out), _tree_restack(s),
+                )
+
+            spec = P(axis)
+            fn = jax.jit(
+                jax.shard_map(
+                    body, mesh=ctx.mesh,
+                    in_specs=(spec, spec, spec, spec),
+                    out_specs=(spec, spec, spec),
+                )
+            )
+            ctx.op_cache[key] = fn
+        win.value, params_out, opt_state = _timed_dispatch(
+            "window_optimizer_step_local", fn,
+            win.value, win.p, opt_state, grads,
+        )
+        return params_out, opt_state
+
     # -- the fused step -------------------------------------------------------
 
     def step(self, opt_state, grads):
@@ -858,6 +981,16 @@ class _WindowOptimizer:
         win = win_mod._get_win(ctx, self._name)
         axis = ctx_mod.WORKER_AXIS
         update_p = win_mod._p_enabled()
+        k = int(self.num_steps_per_communication)
+        if k < 1:
+            raise ValueError(
+                "num_steps_per_communication must be a positive int, got "
+                f"{self.num_steps_per_communication!r}"
+            )
+        comm_now = self._step_count % k == k - 1
+        self._step_count += 1
+        if not comm_now:  # between exchanges: pure local adapt
+            return self._local_step(ctx, win, axis, opt_state, grads)
 
         # Weight *content* never enters the cache key: the compiled program
         # is keyed on the communication structure and takes the resolved
@@ -953,18 +1086,27 @@ class _WindowOptimizer:
         return params_out, opt_state
 
 
-def DistributedWinPutOptimizer(base_optimizer):
+def DistributedWinPutOptimizer(base_optimizer, window_prefix=None,
+                               num_steps_per_communication=1):
     """Diffusion by pushing updated weights into neighbor buffers
     (reference :1271, engine :844-1023)."""
-    return _WindowOptimizer(base_optimizer, mode="put")
+    return _WindowOptimizer(
+        base_optimizer, mode="put", window_prefix=window_prefix,
+        num_steps_per_communication=num_steps_per_communication,
+    )
 
 
-def DistributedPullGetOptimizer(base_optimizer):
+def DistributedPullGetOptimizer(base_optimizer, window_prefix=None,
+                                num_steps_per_communication=1):
     """Diffusion by pulling neighbors' current weights (reference :1225)."""
-    return _WindowOptimizer(base_optimizer, mode="get")
+    return _WindowOptimizer(
+        base_optimizer, mode="get", window_prefix=window_prefix,
+        num_steps_per_communication=num_steps_per_communication,
+    )
 
 
-def DistributedPushSumOptimizer(base_optimizer):
+def DistributedPushSumOptimizer(base_optimizer, window_prefix=None,
+                                num_steps_per_communication=1):
     """Push-sum (directed-graph) asynchronous SGD: sender-stochastic
     win_accumulate of (x, p) with the x/p correction (reference :1180,
     engine :1026-1177).
@@ -979,4 +1121,7 @@ def DistributedPushSumOptimizer(base_optimizer):
     push-sum's exact-average guarantee. The committed numpy oracle for
     both recursions, the sequence-equality proof, and the divergence pin
     live in ``tests/test_pushsum_oracle.py``."""
-    return _WindowOptimizer(base_optimizer, mode="push_sum")
+    return _WindowOptimizer(
+        base_optimizer, mode="push_sum", window_prefix=window_prefix,
+        num_steps_per_communication=num_steps_per_communication,
+    )

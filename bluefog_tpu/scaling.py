@@ -52,19 +52,26 @@ __all__ = [
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
     "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
+    "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
 }
 
 # `dtype[d0,d1,...]{layout} collective-permute(` — the result shape of the
 # instruction is its wire payload (one logical transfer per participating
 # device pair). TPU compilation lowers collectives to async
 # `-start`/`-done` pairs; the `-start` carries the op and payload, so it is
-# counted and the `-done` is not.
+# counted and the `-done` is not. The shape before the op name may be a
+# TUPLE — async starts are `(operands..., results..., contexts...)` and
+# variadic (fusion-combined) collectives return one result per leaf — so
+# the whole shape string is captured and every `dtype[dims]` element
+# parsed, not just the first.
 _COLLECTIVE_RE = re.compile(
-    r"=\s*(?:\()?(\w+)\[([\d,]*)\][^=]*?\s"
+    r"=\s*((?:\()?\w+\[[\d,]*\][^=\n]*?)\s"
     r"(collective-permute|all-reduce|all-gather|reduce-scatter|"
     r"all-to-all)(-start)?\("
 )
+
+_SHAPE_ELEM_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -73,6 +80,34 @@ def _shape_bytes(dtype: str, dims: str) -> int:
         for d in dims.split(","):
             n *= int(d)
     return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+# Async `-start` ops whose result tuple is `(operands..., results...,
+# contexts...)` with operands aliasing results shape-for-shape. all-reduce/
+# reduce-scatter/all-to-all starts return results only (no alias leaves).
+_ALIASING_STARTS = ("collective-permute", "all-gather")
+
+
+def _instruction_bytes(shape_str: str, kind: str, is_start: bool) -> int:
+    """Payload bytes of one collective given its (possibly tuple) shape.
+
+    Plain shape: that shape IS the payload. Tuple on a variadic collective:
+    one result per leaf, so the payload is the sum. Tuple on an aliasing
+    async ``-start`` (collective-permute / all-gather): operands alias
+    results shape-for-shape, so after dropping the scalar u32/s32 context
+    lanes the payload is the second half (counting the whole tuple would
+    double it). Unknown dtypes fall back to 4 bytes rather than vanishing
+    from the accounting.
+    """
+    elems = _SHAPE_ELEM_RE.findall(shape_str)
+    if not shape_str.lstrip().startswith("("):
+        return _shape_bytes(*elems[0]) if elems else 0
+    if is_start and kind in _ALIASING_STARTS:
+        data = [e for e in elems if e[1]]  # drop scalar context lanes
+        if len(data) % 2 == 0 and data:
+            data = data[len(data) // 2:]  # results half
+        return sum(_shape_bytes(dt, dims) for dt, dims in data)
+    return sum(_shape_bytes(dt, dims) for dt, dims in elems)
 
 
 def hlo_collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
@@ -87,10 +122,11 @@ def hlo_collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
     """
     stats: Dict[str, Dict[str, int]] = {}
     for m in _COLLECTIVE_RE.finditer(hlo_text):
-        dtype, dims, kind = m.group(1), m.group(2), m.group(3)
+        shape_str, kind, start = m.group(1), m.group(2), m.group(3)
         entry = stats.setdefault(kind, {"count": 0, "bytes": 0})
         entry["count"] += 1
-        entry["bytes"] += _shape_bytes(dtype, dims)
+        entry["bytes"] += _instruction_bytes(shape_str, kind,
+                                             start is not None)
     return stats
 
 

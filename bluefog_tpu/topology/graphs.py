@@ -125,7 +125,9 @@ def MeshGrid2DGraph(size: int, shape: Optional[Tuple[int, int]] = None) -> nx.Di
             i -= 1
         shape = (i, size // i)
     nrow, ncol = shape
-    assert size == nrow * ncol, "The shape doesn't match the size provided."
+    assert size == nrow * ncol, (
+        f"grid shape {shape} covers {nrow * ncol} nodes, not size={size}"
+    )
 
     adj = np.zeros((size, size))
     for i in range(size):

@@ -77,15 +77,28 @@ class _Allreduce(torch.autograd.Function):
     @staticmethod
     def forward(ctx, t, average):
         ctx.average = average
-        if t.dtype == torch.int64 and not average and t.numel():
+        if t.dtype == torch.int64 and t.numel():
             if not jax.config.jax_enable_x64:
                 size = ctx_mod.get_context().size
-                if t.abs().max().item() * size > 2**31 - 1:
+                mx = t.abs().max().item()
+                if not average and mx * size > 2**31 - 1:
                     raise TypeError(
                         "int64 allreduce sum would exceed int32 range on "
                         "the 32-bit mesh (|max| * world size overflows); "
                         "keep such accumulators out of the distributed "
                         "tree or enable jax_enable_x64."
+                    )
+                # average goes through float32 on the 32-bit mesh, which is
+                # only exact up to 2**24 — fail loud past that bound, same
+                # policy as the sum path's overflow guard. (Values past
+                # int32 range fall through to the boundary's own refusal.)
+                if average and mx <= 2**31 - 1 and mx * size > 2**24:
+                    raise TypeError(
+                        "int64 allreduce average runs in float32 on the "
+                        "32-bit mesh, which is exact only for |sum| <= "
+                        "2**24; cast to float explicitly if approximate "
+                        "averaging is acceptable, or enable "
+                        "jax_enable_x64."
                     )
         return _restore_int64(
             from_numpy(col_ops.allreduce(to_numpy(t), average=average)),

@@ -323,3 +323,130 @@ def test_hierarchical_schedule_must_be_machine_level(cpu_devices):
     state = opt.init(params)
     with pytest.raises(ValueError, match="machine-level"):
         opt.step(params, state, quad_grads(params, targets()))
+
+
+def test_num_steps_per_communication_cta_matches_local_plus_gossip():
+    """K=4: four step() calls == 3 purely-local inner updates + 1 gossiped
+    step (reference torch/optimizers.py:321 — communicate on the K-th
+    call)."""
+    c = targets()
+    tx = optax.sgd(0.2)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        tx, num_steps_per_communication=4
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    for _ in range(4):
+        params, state = opt.step(params, state, quad_grads(params, c))
+
+    # reference sequence: 3 empty-communication (local) steps, then one
+    # K=1 neighbor-allreduce step, all over the same inner transformation
+    local = bf.DistributedAdaptWithCombineOptimizer(
+        tx, bf.CommunicationType.empty
+    )
+    comm = bf.DistributedNeighborAllreduceOptimizer(tx)
+    p2 = make_params(c)
+    s2 = local.init(p2)
+    for _ in range(3):
+        p2, s2 = local.step(p2, s2, quad_grads(p2, c))
+    p2, s2 = comm.step(p2, s2, quad_grads(p2, c))
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), np.asarray(p2["w"]), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_num_steps_per_communication_grad_accumulates():
+    """Gradient order: K-1 calls accumulate locally with params untouched;
+    the K-th allreduces the accumulated sum and applies ONE inner update —
+    classic gradient accumulation (reference optimizers.py:443,166-295)."""
+    c = targets()
+    tx = optax.sgd(0.1)
+    opt = bf.DistributedGradientAllreduceOptimizer(
+        tx, num_steps_per_communication=3
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    # constant NONZERO per-worker grads for checkable algebra (at the
+    # start params == targets, so quad_grads would be identically zero
+    # and the assertions vacuous)
+    g = {"w": bf.worker_values(
+        lambda r: np.full((DIM,), 0.5 + r, np.float32)
+    )}
+    p1, s1 = opt.step(params, state, g)
+    np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                  np.asarray(params["w"]))
+    p2, s2 = opt.step(p1, s1, g)
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.asarray(params["w"]))
+    p3, s3 = opt.step(p2, s2, g)  # the communicating call
+
+    ref = bf.DistributedGradientAllreduceOptimizer(tx)
+    pr = make_params(c)
+    sr = ref.init(pr)
+    g3 = jax.tree_util.tree_map(lambda t: 3.0 * t, g)
+    pr, sr = ref.step(pr, sr, g3)
+    np.testing.assert_allclose(np.asarray(p3["w"]), np.asarray(pr["w"]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_num_steps_per_communication_schedule_advances_per_comm():
+    """Dynamic schedules index by COMMUNICATION round, not call count:
+    a K=2 optimizer walks the schedule at half the call rate."""
+    c = targets()
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), num_steps_per_communication=2
+    )
+    opt.schedule = schedule_from_dynamic(
+        SIZE, lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialGraph(SIZE), r
+        )
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    for _ in range(6):
+        params, state = opt.step(params, state, quad_grads(params, c))
+    assert opt._step_count == 6 and opt._comm_count == 3
+
+
+def test_num_steps_per_communication_window_local_steps_skip_exchange():
+    """Window families: between-communication calls leave every neighbor
+    buffer (and version counter) untouched; the K-th call exchanges.
+    Consensus still forms (the delay only slows mixing)."""
+    c = targets()
+    opt = bf.DistributedWinPutOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 20, 0.5)),
+        num_steps_per_communication=2,
+    )
+    params = make_params(c)
+    state = opt.init(params)
+    ctx = bf.get_context()
+    from bluefog_tpu import windows as win_mod
+
+    win = win_mod._get_win(ctx, opt._name)
+    bufs0 = np.asarray(win.buffers).copy()
+    cache = ctx.op_cache
+    before = set(cache)
+    cur, state = opt.step(state, quad_grads(params, c))  # local (1st of 2)
+    new_keys = [k for k in cache if k not in before]
+    assert [k[0] for k in new_keys] == ["wopt_local_step"], new_keys
+    # no exchange happened: every neighbor buffer is untouched
+    np.testing.assert_array_equal(np.asarray(win.buffers), bufs0)
+    before = set(cache)
+    cur, state = opt.step(state, quad_grads(cur, c))  # the exchanging call
+    assert any(k[0] == "wopt_fused_step" for k in cache if k not in before)
+    start = global_loss(params, c)
+    for _ in range(140):
+        cur, state = opt.step(state, quad_grads(cur, c))
+    assert global_loss(cur, c) < 0.1 * start
+    assert disagreement(cur) < 0.3
+    opt.free()
+
+
+def test_num_steps_per_communication_validation():
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1), num_steps_per_communication=0
+    )
+    params = make_params(targets())
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="positive"):
+        opt.step(params, state, params)

@@ -139,3 +139,39 @@ def test_hlo_stats_counts_async_start_forms():
     stats = scaling.hlo_collective_stats(txt)
     assert stats["collective-permute"] == {"count": 1, "bytes": 400}, stats
     assert stats["all-reduce"] == {"count": 1, "bytes": 64}, stats
+
+
+def test_hlo_stats_tuple_shapes():
+    """Tuple-shaped instructions: the real-TPU async form carries scalar
+    u32[] context lanes next to the operand-alias/result pair (count the
+    result half only), and fusion-combined variadic collectives return one
+    result per leaf (count them all)."""
+    txt = """
+  %cp = (f32[100]{0}, f32[100]{0}, u32[], u32[]) collective-permute-start(%x), source_target_pairs={{0,1}}
+  %cpd = f32[100]{0} collective-permute-done(%cp)
+  %var = (f32[10]{0}, bf16[20]{0}) all-reduce(%a, %b), to_apply=%add
+"""
+    stats = scaling.hlo_collective_stats(txt)
+    assert stats["collective-permute"] == {"count": 1, "bytes": 400}, stats
+    assert stats["all-reduce"] == {"count": 1, "bytes": 80}, stats
+
+
+def test_hlo_stats_variadic_all_reduce_start_counts_all_results():
+    """An async variadic all-reduce-start's tuple is results-only (no
+    operand aliases) — the alias-halving must be gated to
+    collective-permute / all-gather, even when the leaf count is even."""
+    txt = """
+  %ar = (f32[1000]{0}, f32[1000]{0}) all-reduce-start(%a, %b), to_apply=%add
+  %ard = (f32[1000]{0}, f32[1000]{0}) all-reduce-done(%ar)
+"""
+    stats = scaling.hlo_collective_stats(txt)
+    assert stats["all-reduce"] == {"count": 1, "bytes": 8000}, stats
+
+
+def test_hlo_stats_unknown_dtype_falls_back_not_zero():
+    """A dtype missing from the table must not silently vanish from the
+    byte accounting (a compressed wire would then pass flat-bytes
+    assertions vacuously); it falls back to 4 bytes/elem."""
+    txt = "  %cp = f4e2m1[64]{0} collective-permute(%x)\n"
+    stats = scaling.hlo_collective_stats(txt)
+    assert stats["collective-permute"]["bytes"] == 64 * 4, stats

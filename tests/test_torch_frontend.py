@@ -325,6 +325,16 @@ def test_int64_results_keep_dtype_and_sum_overflow_refused():
         bft.allreduce(near, average=False)
 
 
+def test_int64_average_inexact_refused():
+    """average=True runs through float32, exact only up to |sum| <= 2**24;
+    the guard is symmetric with the sum path's overflow refusal."""
+    small = torch.full((SIZE, 2), 1000, dtype=torch.int64)
+    assert bft.allreduce(small, average=True)[0, 0].item() == 1000.0
+    big = torch.full((SIZE, 2), 2**24, dtype=torch.int64)  # in int32 range
+    with pytest.raises(TypeError, match="float32"):
+        bft.allreduce(big, average=True)
+
+
 def test_neighbor_optimizer_dynamic_topology_idiom():
     """The reference's per-iteration weight-reassignment idiom
     (README.rst:108-123) through the torch wrapper: assign self/src/dst
