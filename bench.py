@@ -1,21 +1,30 @@
 #!/usr/bin/env python
 # Copyright 2026. Licensed under the Apache License, Version 2.0.
-"""Headline benchmark: ResNet50 decentralized train-step throughput.
+"""Benchmark driver: the full performance evidence set in one run.
 
-Mirrors the reference benchmark driver (``examples/pytorch_benchmark.py``:
-ResNet50, bs=64 per worker, neighbor_allreduce optimizer) on one TPU chip.
-Baseline: BlueFog-NCCL ResNet50 at 4310.6 img/s total on 16 V100s
-(docs/performance.rst:16-24) = 269.4 img/s per accelerator; vs_baseline is
-imgs/sec-per-chip against that per-accelerator number.
+Default (no BENCH_MODE): emits EVERY metric family — scaling accounting,
+gossip overhead (with the <5 % regression assertion on TPU), flash-vs-
+dense attention timings, transformer throughput — each in an isolated
+subprocess, then the ResNet50 headline line LAST (so a tail-reading
+driver still lands on the headline). Every line is standalone JSON.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu", ...}.
-``mfu`` uses the 2*MAC FLOP convention (ResNet50 fwd ~= 8.2 GFLOP/img,
-fwd+bwd ~= 3x fwd) against the device's peak bf16 FLOP/s.
+Individual families via ``BENCH_MODE``:
 
-``BENCH_MODE=scaling`` instead emits the scaling-efficiency evidence
-(reference docs/performance.rst:26-53, README.rst:51-60): static per-step
-comm accounting from compiled HLO for one-peer gossip vs allreduce across
-mesh sizes, plus weak-scaling step times on the available devices.
+- ``headline``: ResNet50 decentralized train step, mirroring the
+  reference benchmark driver (``examples/pytorch_benchmark.py``: bs=64
+  per worker, neighbor_allreduce optimizer). Baseline: BlueFog-NCCL
+  ResNet50 at 4310.6 img/s total on 16 V100s (docs/performance.rst:16-24)
+  = 269.4 img/s per accelerator; ``vs_baseline`` is imgs/sec-per-chip
+  against that. ``mfu`` uses the 2*MAC FLOP convention. Best-of-N timed
+  windows with the min/median spread disclosed.
+- ``transformer``: TransformerLM (bf16, dim 1024 / 16 heads / 12 layers,
+  T=4096) train-step tokens/sec + MFU over the Pallas flash kernels.
+- ``flash``: flash-vs-dense attention fwd / fwd+bwd timings at
+  T in {1k, 4k, 8k} (the measured basis for flash-by-default).
+- ``gossip``: gossip-overhead bound with communication REALLY in the
+  program; asserts overhead < 5 % on TPU (regression check).
+- ``scaling``: static HLO comm accounting + weak-scaling harness
+  (reference docs/performance.rst:26-53, README.rst:51-60).
 """
 
 import json
@@ -164,7 +173,12 @@ def run_headline() -> int:
     # through a shared tunnel, so a single window can absorb unrelated
     # stalls; the best window is the reproducible hardware number (each
     # window is still steps>=20 long).
-    best_dt = None
+    # Differenced windows: time N steps + settle and 2N steps + settle;
+    # the difference is N steps of pure compute with the ~100+-50 ms
+    # tunnel settle RTT cancelled EXACTLY (the r03/r04 single-window
+    # readback correction only cancelled it in expectation, and was
+    # observed to swing the result by several % either way).
+    dts = []
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", "8" if on_tpu else "1")))
     for _ in range(windows):
         t0 = time.perf_counter()
@@ -172,20 +186,29 @@ def run_headline() -> int:
             state, loss = fn(state, images, labels)
         _settle(loss)
         t1 = time.perf_counter()
-        _settle(loss)  # already materialized: measures pure readback latency
-        t_read = time.perf_counter() - t1
-        dt = max(t1 - t0 - t_read, 1e-9)
-        if best_dt is None or dt < best_dt:
-            best_dt = dt
+        for _ in range(2 * steps):
+            state, loss = fn(state, images, labels)
+        _settle(loss)
+        t2 = time.perf_counter()
+        dts.append(max((t2 - t1) - (t1 - t0), 1e-9))
+    best_dt = min(dts)
+    dts.sort()
+    median_dt = dts[len(dts) // 2]
 
-    imgs_per_sec = n * batch * steps / best_dt
-    per_chip = imgs_per_sec / n
+    per_window = n * batch * steps
+    per_chip = per_window / best_dt / n
     baseline_per_accel = 4310.6 / 16.0  # docs/performance.rst:16-24
     result = {
         "metric": "resnet50_bs%d_imgs_per_sec_per_chip" % batch,
         "value": round(per_chip, 2),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / baseline_per_accel, 4),
+        # window spread: best-of-N filters shared-tunnel stalls; the
+        # median and worst window are disclosed so the headline is not
+        # mistaken for a guaranteed-reproducible number
+        "windows": windows,
+        "median": round(per_window / median_dt / n, 2),
+        "min": round(per_window / max(dts) / n, 2),
     }
     peak = _peak_flops(devices[0])
     if peak:
@@ -394,16 +417,26 @@ def run_gossip_overhead() -> int:
                 params, batch_stats, opt_state, images, labels
             )
         _settle(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, batch_stats, opt_state, loss = fn(
-                params, batch_stats, opt_state, images, labels
-            )
-        _settle(loss)
-        t1 = time.perf_counter()
-        _settle(loss)
-        t_read = time.perf_counter() - t1
-        return max(t1 - t0 - t_read, 1e-9) / steps
+        best = None
+        for _ in range(2):
+            # differenced windows: RTT cancelled exactly (see headline)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, batch_stats, opt_state, loss = fn(
+                    params, batch_stats, opt_state, images, labels
+                )
+            _settle(loss)
+            t1 = time.perf_counter()
+            for _ in range(2 * steps):
+                params, batch_stats, opt_state, loss = fn(
+                    params, batch_stats, opt_state, images, labels
+                )
+            _settle(loss)
+            t2 = time.perf_counter()
+            dt = max((t2 - t1) - (t1 - t0), 1e-9) / steps
+            if best is None or dt < best:
+                best = dt
+        return best
 
     copy = lambda tr: jax.tree_util.tree_map(lambda t: t + 0.0, tr)
     dt_plain = timed(make(False), (copy(params), copy(batch_stats),
@@ -428,6 +461,7 @@ def run_gossip_overhead() -> int:
     dt_copy = max(t1 - t0 - (time.perf_counter() - t1), 1e-9) / copy_iters
 
     total = n_virt * batch
+    overhead_pct = 100.0 * (dt_gossip - dt_plain) / dt_plain
     for line in (
         {"metric": "gossip_step_no_comm", "workers_on_chip": n_virt,
          "imgs_per_sec": round(total / dt_plain, 1),
@@ -435,12 +469,267 @@ def run_gossip_overhead() -> int:
         {"metric": "gossip_step_with_combine", "workers_on_chip": n_virt,
          "imgs_per_sec": round(total / dt_gossip, 1),
          "ms_per_step": round(dt_gossip * 1e3, 2),
-         "gossip_overhead_pct": round(
-             100.0 * (dt_gossip - dt_plain) / dt_plain, 2)},
+         "gossip_overhead_pct": round(overhead_pct, 2)},
         {"metric": "model_hbm_roundtrip", "ms": round(dt_copy * 1e3, 3)},
     ):
         print(json.dumps(line))
+    if on_tpu and os.environ.get("BENCH_ASSERT", "1") != "0":
+        # regression assertion (reference analogue:
+        # scripts/pytorch_opt_linear_speedup_test.py asserts, not narrates)
+        assert overhead_pct < 5.0, (
+            f"gossip combine overhead regressed to {overhead_pct:.2f}% "
+            "(must stay < 5% of the compute step)"
+        )
     return 0
+
+
+def run_transformer() -> int:
+    """TransformerLM train-step throughput: tokens/sec + MFU at long
+    sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
+
+    The reference has no transformer or long-context tier (SURVEY §5);
+    this number backs the beyond-reference attention stack with the same
+    measured-claims discipline as the headline
+    (reference docs/performance.rst:16-24)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bluefog_tpu.models.transformer import TransformerLM
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    seq = int(os.environ.get("BENCH_SEQ", "4096" if on_tpu else "128"))
+    batch = int(os.environ.get("BENCH_TLM_BATCH", "2" if on_tpu else "1"))
+    dim = int(os.environ.get("BENCH_TLM_DIM", "1024" if on_tpu else "64"))
+    heads = int(os.environ.get("BENCH_TLM_HEADS", "16" if on_tpu else "4"))
+    layers = int(os.environ.get("BENCH_TLM_LAYERS", "12" if on_tpu else "2"))
+    vocab = int(os.environ.get("BENCH_TLM_VOCAB", "16384" if on_tpu else "256"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "2")))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "8" if on_tpu else "1")))
+
+    model = TransformerLM(
+        vocab=vocab, dim=dim, heads=heads, layers=layers, max_len=seq,
+        dtype=jnp.bfloat16,
+    )
+    rng_np = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng_np.randint(0, vocab, (batch, seq)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state, loss
+
+    carry = (params, opt_state)
+
+    def step(tokens):
+        nonlocal carry
+        p, s, loss = train_step(carry[0], carry[1], tokens)
+        carry = (p, s)
+        return loss  # scalar: safe to settle through the tunnel
+
+    # differenced windows (time N then 2N steps; subtracting cancels the
+    # ~100 ms +-50 ms tunnel settle RTT exactly, which a single-window
+    # readback correction only cancels in expectation)
+    loss = step(tokens)
+    _settle(loss)
+    _settle(loss)
+    dt = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(tokens)
+        _settle(loss)
+        t1 = time.perf_counter()
+        for _ in range(2 * steps):
+            loss = step(tokens)
+        _settle(loss)
+        t2 = time.perf_counter()
+        d = max((t2 - t1) - (t1 - t0), 1e-9) / steps
+        if dt is None or d < dt:
+            dt = d
+    tok_per_sec = batch * seq / dt
+    # fwd FLOPs/token = 2*P (params matmuls) + 2*T*dim*L (causal QK^T+PV
+    # at average context T/2, both 2*MAC); fwd+bwd = 3x fwd
+    flops_token = 3 * (2 * n_params + 2 * seq * dim * layers)
+    result = {
+        "metric": "transformer_lm_tokens_per_sec",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "seq_len": seq,
+        "params_m": round(n_params / 1e6, 1),
+        "dim": dim, "heads": heads, "layers": layers, "batch": batch,
+        "attention": "pallas_flash",
+    }
+    peak = _peak_flops(jax.devices()[0])
+    if peak:
+        result["mfu"] = round(tok_per_sec * flops_token / peak, 4)
+        result["device"] = jax.devices()[0].device_kind
+    print(json.dumps(result))
+    return 0
+
+
+def run_flash() -> int:
+    """Flash-vs-dense attention timings: the measured basis for the
+    flash-by-default decision (VERDICT r04 item 1). Emits one line per
+    (shape, direction) with the speedup; on TPU asserts flash wins at
+    long sequence so a kernel regression fails the bench."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops.attention import reference_attention
+    from bluefog_tpu.ops.flash import flash_attention
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "3" if on_tpu else "1")))
+    seqs = [
+        int(s) for s in os.environ.get(
+            "BENCH_FLASH_SEQS", "1024,4096,8192" if on_tpu else "256"
+        ).split(",")
+    ]
+    speedups = {}
+    for h, d in ((16, 64), (8, 128)):
+        for t in seqs:
+            rng = np.random.RandomState(0)
+            q, k, v = (
+                jnp.asarray(rng.randn(1, t, h, d), jnp.bfloat16)
+                for _ in range(3)
+            )
+
+            def mk(fn):
+                # both timed programs return a SCALAR so the settle point
+                # is a fixed cheap readback (settling a [T,H,D] output
+                # through the tunnel would swamp the measurement)
+                fwd = jax.jit(
+                    lambda q, k, v: fn(q, k, v, causal=True)
+                    .astype(jnp.float32).mean()
+                )
+
+                def loss(q, k, v):
+                    return fn(q, k, v, causal=True).astype(
+                        jnp.float32
+                    ).mean()
+
+                bwd = jax.jit(
+                    lambda q, k, v: sum(
+                        g.astype(jnp.float32).sum()
+                        for g in jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                    )
+                )
+                return fwd, bwd
+
+            f_fwd, f_bwd = mk(flash_attention)
+            r_fwd, r_bwd = mk(reference_attention)
+
+            def measure(fn, cost_mult):
+                # The tunnel settle RTT is ~100 ms with +-50 ms jitter, so
+                # sub-second windows are pure noise. Differenced windows
+                # cancel the RTT exactly: time N steps + settle and
+                # 2N steps + settle; the difference is N steps of pure
+                # compute. Steps are sized from the analytic FLOP count to
+                # ~1 s of compute per N.
+                flops = 2.0 * t * t * h * d * 1 * cost_mult  # causal ~half
+                est = flops / 2.0e13  # ~10% of peak as a sizing guess
+                steps = max(8, min(4096, int(1.0 / max(est, 1e-7))))
+                out = fn(q, k, v)
+                _settle(out)
+                _settle(out)
+                best = None
+                for _ in range(windows):
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        out = fn(q, k, v)
+                    _settle(out)
+                    t1 = time.perf_counter()
+                    for _ in range(2 * steps):
+                        out = fn(q, k, v)
+                    _settle(out)
+                    t2 = time.perf_counter()
+                    dt = max((t2 - t1) - (t1 - t0), 1e-9) / steps
+                    if best is None or dt < best:
+                        best = dt
+                return best
+
+            tf, tr = measure(f_fwd, 1), measure(r_fwd, 2)
+            tfb, trb = measure(f_bwd, 3), measure(r_bwd, 6)
+            speedups[(h, d, t)] = (tr / tf, trb / tfb)
+            print(json.dumps({
+                "metric": "flash_attention_vs_dense",
+                "seq_len": t, "heads": h, "head_dim": d, "causal": True,
+                "flash_fwd_ms": round(tf * 1e3, 3),
+                "dense_fwd_ms": round(tr * 1e3, 3),
+                "fwd_speedup": round(tr / tf, 2),
+                "flash_fwdbwd_ms": round(tfb * 1e3, 3),
+                "dense_fwdbwd_ms": round(trb * 1e3, 3),
+                "fwdbwd_speedup": round(trb / tfb, 2),
+            }))
+    if on_tpu and os.environ.get("BENCH_ASSERT", "1") != "0":
+        # stall-robust regression check: a single tunnel stall can distort
+        # one cell, so require every long config to win in at least one
+        # direction and at least one to win decisively in both
+        long_wins = [
+            s for (h, d, t), s in speedups.items() if t >= 4096
+        ]
+        if long_wins:  # no long configs measured != a kernel regression
+            assert all(
+                max(fwd, bwd) > 1.0 for fwd, bwd in long_wins
+            ) and any(
+                fwd > 1.5 and bwd > 1.5 for fwd, bwd in long_wins
+            ), f"flash lost to dense at long sequence: {speedups}"
+    return 0
+
+
+def run_all() -> int:
+    """The full evidence set: each family in an isolated subprocess (the
+    scaling family must own backend init; a family crash must not take
+    out the headline), headline last for tail-reading drivers."""
+    import subprocess
+
+    for mode in ("scaling", "gossip", "flash", "transformer"):
+        env = dict(os.environ, BENCH_MODE=mode)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=2400,
+            )
+        except subprocess.TimeoutExpired as e:
+            # isolation contract: a hung family must not take out the
+            # remaining families or the headline
+            print(json.dumps({
+                "metric": f"bench_{mode}_failed",
+                "timeout_s": 2400,
+                "stdout_tail": (e.stdout or b"").decode(
+                    "utf-8", "replace"
+                )[-200:] if isinstance(e.stdout, bytes)
+                else (e.stdout or "")[-200:],
+            }), flush=True)
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+        if proc.returncode != 0:
+            print(json.dumps({
+                "metric": f"bench_{mode}_failed",
+                "returncode": proc.returncode,
+                "stderr_tail": proc.stderr[-400:],
+            }), flush=True)
+    return run_headline()
 
 
 def main() -> int:
@@ -449,7 +738,13 @@ def main() -> int:
         return run_scaling()
     if mode == "gossip":
         return run_gossip_overhead()
-    return run_headline()
+    if mode == "transformer":
+        return run_transformer()
+    if mode == "flash":
+        return run_flash()
+    if mode == "headline":
+        return run_headline()
+    return run_all()
 
 
 if __name__ == "__main__":

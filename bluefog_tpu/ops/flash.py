@@ -1,18 +1,27 @@
 # Copyright 2026. Licensed under the Apache License, Version 2.0.
-"""Pallas flash-attention kernel for the local attention hot op.
+"""Pallas flash-attention kernels for the local attention hot op.
 
 The sequence-parallel layers (:mod:`bluefog_tpu.ops.attention`) delegate
 their per-device block attention to XLA by default; this module provides
-the hand-tiled TPU kernel for the same math — flash-attention online
+the hand-tiled TPU kernels for the same math — flash-attention online
 softmax with one pass over K/V tiles, f32 accumulators in VMEM, causal
 tiles skipped entirely (not just masked) so the causal kernel does half
 the work. Layout follows the MXU/VPU tiling rules: Q/K/V tiles are
 ``[block, head_dim]`` with ``head_dim`` and blocks multiples of 128 lanes
-/ 8 sublanes (``pallas_guide``: tiling constraints).
+/ 8 sublanes (``pallas_guide``: tiling constraints). Ragged sequence
+lengths and narrow heads tile via zero padding + in-kernel masking (an
+O(T·d) copy), never an O(T²) dense fallback.
+
+Training-ready: a ``jax.custom_vjp`` pairs the forward kernel (which also
+emits the per-row logsumexp) with FlashAttention-2-style backward kernels
+(dK/dV accumulated over Q tiles; dQ over K tiles; both recompute the
+probabilities from Q/K and the saved logsumexp instead of materializing
+the T×T matrix).
 
 ``flash_attention`` falls back to the dense XLA path off-TPU or for
-shapes the tiling cannot cover, so callers can use it unconditionally.
-``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
+cross-attention (mismatched Q/KV shapes), so callers can use it
+unconditionally. ``interpret=True`` runs the kernels in the Pallas
+interpreter (CPU CI).
 """
 
 import functools
@@ -32,17 +41,56 @@ except ImportError:  # pragma: no cover
 __all__ = ["flash_attention", "flash_attention_supported"]
 
 _LANES = 128
+# lse/delta row vectors ride in [bh, t_pad, _SUB] tensors: Mosaic requires
+# the last block dim to be 128-divisible OR equal to the array dim, and a
+# width-8 trailing dim keeps the residual 16x smaller than lane-width.
+_SUB = 8
+_NEG_INF = -jnp.inf
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-            *, scale, causal, block_q, block_k):
+def _positions(iq, ik, block_q, block_k):
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    kpos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return qpos, kpos
+
+
+def _keep_mask(iq, ik, block_q, block_k, causal, kv_len, t_pad):
+    """Static-shape validity mask for one score tile, or None when every
+    entry is valid (divisible, non-causal shapes compile mask-free).
+
+    Raggedness is judged against the PADDED length, not ``block_k``
+    alone: with block_q != block_k the lcm rounding can append
+    whole-block K padding even when kv_len divides block_k, and those
+    tiles must be masked too."""
+    ragged = kv_len < t_pad
+    if not (causal or ragged):
+        return None
+    qpos, kpos = _positions(iq, ik, block_q, block_k)
+    keep = None
+    if causal:
+        keep = qpos >= kpos
+    if ragged:
+        valid = kpos < kv_len
+        keep = valid if keep is None else keep & valid
+    return keep
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, kv_len, t_pad):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _tile():
@@ -52,14 +100,10 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        keep = _keep_mask(iq, ik, block_q, block_k, causal, kv_len,
+                          t_pad)
+        if keep is not None:
+            s = jnp.where(keep, s, _NEG_INF)
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(-1))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -85,23 +129,277 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     @pl.when(ik == pl.num_programs(2) - 1)
     def _finalize():
         l = l_ref[:, 0]
+        m = m_ref[:, 0]
         l_safe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        # logsumexp per row; -inf marks rows with no valid key (padding)
+        lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref[0].shape)
+
+
+def _vma(x):
+    # inside shard_map the outputs vary over the same mesh axes as the
+    # inputs; pallas out_shapes must carry that or the vma check rejects
+    # the trace (platform_dependent traces the kernel branch everywhere)
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
+def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
+              interpret):
+    bh, t_pad, d_pad = qf.shape
+    vma = _vma(qf)
+    grid = (bh, t_pad // block_q, t_pad // block_k)
+    return pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), qf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t_pad, _SUB), jnp.float32, vma=vma),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_q, _SUB), lambda b, iq, ik: (b, iq, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+# -- backward (FlashAttention-2 style) ---------------------------------------
+
+
+def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale, causal, block_q,
+                 block_k, kv_len, t_pad):
+    """Rebuild the probability tile from Q/K and the saved logsumexp."""
+    s = jax.lax.dot_general(
+        q_ref, k_ref, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    keep = _keep_mask(iq, ik, block_q, block_k, causal, kv_len, t_pad)
+    if keep is not None:
+        s = jnp.where(keep, s, _NEG_INF)
+    lse = lse_ref[:, 0]  # [block_q] (stored _SUB wide)
+    finite = jnp.isfinite(lse)
+    p = jnp.exp(s - jnp.where(finite, lse, 0.0)[:, None])
+    # rows with lse=-inf are padding (no valid keys); -inf scores are
+    # masked slots
+    p = jnp.where(finite[:, None] & jnp.isfinite(s), p, 0.0)
+    return p
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k, kv_len, t_pad):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _tile():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], iq, ik, scale, causal,
+            block_q, block_k, kv_len, t_pad,
+        )  # [block_q, block_k]
+        do = do_ref[0]  # [block_q, d]
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dP = dO V^T ; dS = P * (dP - D) * scale
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None]) * scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * block_k < (iq + 1) * block_q)(_tile)
+    else:
+        _tile()
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc,
+                   *, scale, causal, block_q, block_k, kv_len, t_pad):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _tile():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], iq, ik, scale, causal,
+            block_q, block_k, kv_len, t_pad,
+        )
+        do = do_ref[0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None]) * scale
+        # dQ += dS K
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * block_k < (iq + 1) * block_q)(_tile)
+    else:
+        _tile()
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
+              kv_len, interpret):
+    bh, t_pad, d_pad = qf.shape
+    # D_i = rowsum(dO_i * O_i) — O(T d) elementwise, fine in XLA
+    delta = jnp.sum(
+        do.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+    )
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_SUB,))
+    vma = _vma(qf)
+    q_spec = pl.BlockSpec((1, block_q, d_pad), lambda b, ik, iq: (b, iq, 0))
+    k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0))
+    r_spec = pl.BlockSpec((1, block_q, _SUB), lambda b, ik, iq: (b, iq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), kf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), vf.dtype, vma=vma),
+        ),
+        grid=(bh, t_pad // block_k, t_pad // block_q),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+    q_spec2 = pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, _SUB), lambda b, iq, ik: (b, iq, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qf.dtype,
+                                       vma=vma),
+        grid=(bh, t_pad // block_q, t_pad // block_k),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+# -- custom-vjp wrapper over padded folded tensors ---------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_fn(causal, scale, block_q, block_k, kv_len, interpret):
+    """Differentiable flash attention on folded-padded [bh, t_pad, d_pad]
+    tensors; one cached custom_vjp per static configuration."""
+
+    @jax.custom_vjp
+    def f(qf, kf, vf):
+        out, _lse = _fwd_call(
+            qf, kf, vf, causal, scale, block_q, block_k, kv_len, interpret
+        )
+        return out
+
+    def f_fwd(qf, kf, vf):
+        out, lse = _fwd_call(
+            qf, kf, vf, causal, scale, block_q, block_k, kv_len, interpret
+        )
+        return out, (qf, kf, vf, out, lse)
+
+    def f_bwd(res, do):
+        qf, kf, vf, out, lse = res
+        return _bwd_call(
+            qf, kf, vf, out, lse, do, causal, scale, block_q, block_k,
+            kv_len, interpret,
+        )
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
 
 
 def flash_attention_supported(q, k=None, v=None, *, block_q: int = 128,
                               block_k: int = 128) -> bool:
-    """Tiling feasibility: self-attention shapes (the kernel assumes one
-    shared sequence length), seq divisible by the blocks, head_dim a lane
-    multiple."""
-    _b, t, _h, d = q.shape
+    """Kernel applicability: self-attention shapes only (one shared
+    sequence length). Arbitrary sequence length and head_dim are handled
+    by padded-with-masking tiles — an O(T) copy, never an O(T²) dense
+    fallback — so only cross-attention / mismatched shapes fall back."""
+    del block_q, block_k  # any T tiles via padding; kept for API compat
     for other in (k, v):
         if other is not None and tuple(other.shape) != tuple(q.shape):
             return False  # cross-attention / mismatched shapes: fall back
-    return (
-        t % block_q == 0 and t % block_k == 0 and d % _LANES == 0
-        and t >= max(block_q, block_k)
-    )
+    return q.ndim == 4 and q.shape[1] >= 1
+
+
+def _pad_to(x, t_pad, d_pad):
+    b, t, h, d = x.shape
+    if t == t_pad and d == d_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0), (0, d_pad - d)))
+
+
+def _auto_block(t: int) -> int:
+    """Largest tile in {1024..128} whose padding waste stays under ~15%.
+
+    Big tiles are what make the kernel fast — at T=8192/d=64 the measured
+    forward is 2.3 ms with 1024-tiles vs 23 ms with 128-tiles (the grid
+    shrinks 64x, so per-tile overhead stops dominating) — but padding a
+    ragged tail up to a huge tile would waste more compute than the tile
+    saves."""
+    for b in (1024, 512, 256, 128):
+        t_pad = -(-t // b) * b
+        if t_pad - t <= max(t // 8, 127):
+            return b
+    return 128
 
 
 @functools.partial(
@@ -110,53 +408,62 @@ def flash_attention_supported(q, k=None, v=None, *, block_q: int = 128,
 )
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     b, t, h, d = q.shape
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    qf, kf, vf = fold(q), fold(k), fold(v)
-    grid = (b * h, t // block_q, t // block_k)
-    out = pl.pallas_call(
-        functools.partial(
-            _kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if block_q is None:
+        block_q = _auto_block(t)
+    if block_k is None:
+        block_k = block_q
+    # ragged tails tile via zero padding: padded K positions are masked to
+    # -inf in-kernel (zero softmax weight), padded Q rows are discarded.
+    # Cost: one O(T*d) copy, not O(T^2). head_dim needs no padding — the
+    # kernel blocks span the full head axis, and Mosaic accepts any block
+    # dim equal to the overall array dim (lane packing is its job; an
+    # explicit pad to 128 would double the matmul FLOPs at d=64).
+    tile = int(np.lcm(block_q, block_k))
+    t_pad = -(-t // tile) * tile
+    d_pad = d
+    qp, kp, vp = (_pad_to(x, t_pad, d_pad) for x in (q, k, v))
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d_pad)
+    fn = _flash_fn(causal, scale, block_q, block_k, t, interpret)
+    out = fn(fold(qp), fold(kp), fold(vp))
+    out = out.reshape(b, h, t_pad, d_pad).transpose(0, 2, 1, 3)
+    return out[:, :t, :, :d]
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False):
     """Flash attention on ``[batch, seq, heads, head_dim]`` tensors.
 
-    Uses the Pallas TPU kernel when the platform and tiling allow;
-    otherwise falls back to the dense XLA attention (same math)."""
+    Uses the Pallas TPU kernels (forward AND backward — safe inside
+    ``jax.grad``) for any self-attention shape; only cross-attention /
+    mismatched shapes and non-TPU platforms fall back to the dense XLA
+    attention (same math). Tile sizes default to the largest that fits
+    the sequence without excessive padding (see :func:`_auto_block`);
+    pass ``block_q``/``block_k`` to override."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     from bluefog_tpu.ops.attention import reference_attention
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if (
-        pltpu is None
-        or not flash_attention_supported(q, k, v, block_q=block_q,
-                                         block_k=block_k)
-        or not (on_tpu or interpret)
+    if pltpu is None or not flash_attention_supported(
+        q, k, v, block_q=block_q, block_k=block_k
     ):
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, float(scale), block_q, block_k,
-                  interpret)
+    if interpret:
+        return _flash(q, k, v, causal, float(scale), block_q, block_k,
+                      True)
+    # The kernel-vs-dense choice must follow the platform the computation
+    # actually LOWERS for, not the default backend: a CPU mesh inside a
+    # TPU-ambient process (the dev/test pattern) would otherwise try to
+    # lower the Mosaic kernel for CPU. platform_dependent resolves at
+    # lowering time, per backend.
+    return jax.lax.platform_dependent(
+        q, k, v,
+        tpu=lambda q, k, v: _flash(
+            q, k, v, causal, float(scale), block_q, block_k, False
+        ),
+        default=lambda q, k, v: reference_attention(
+            q, k, v, causal=causal, scale=scale
+        ).astype(q.dtype),  # branch outputs must agree: dense promotes
+    )

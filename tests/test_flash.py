@@ -46,18 +46,75 @@ def test_blocks_tile_the_sequence():
     )
 
 
-def test_support_predicate_and_fallback():
+def test_support_predicate_covers_ragged_shapes():
+    """Arbitrary T and head_dim are kernel-supported (padded-masked
+    tiles); only cross-attention shapes are excluded."""
     q, k, v = qkv()
     assert flash_attention_supported(q)
-    assert not flash_attention_supported(jnp.zeros((1, 100, 2, 128)))
-    assert not flash_attention_supported(jnp.zeros((1, 256, 2, 96)))
-    # unsupported shapes fall back to the dense path, same semantics
-    qs = jnp.asarray(np.random.RandomState(2).randn(1, 100, 2, 96),
-                     jnp.float32)
-    out = flash_attention(qs, qs, qs, causal=True)
-    ref = reference_attention(qs, qs, qs, causal=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=1e-6, atol=1e-6)
+    assert flash_attention_supported(jnp.zeros((1, 100, 2, 128)))
+    assert flash_attention_supported(jnp.zeros((1, 256, 2, 96)))
+    assert flash_attention_supported(jnp.zeros((1, 4097, 2, 96)))
+    assert not flash_attention_supported(
+        jnp.zeros((1, 256, 2, 128)), jnp.zeros((1, 512, 2, 128))
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,d", [(100, 128), (130, 96), (257, 64)])
+def test_ragged_tails_match_dense(causal, t, d):
+    """Sequences and head dims off the 128 grid go through the kernel
+    (padded + masked), not the O(T^2) dense fallback, and match it."""
+    rng = np.random.RandomState(4)
+    q, k, v = (
+        jnp.asarray(rng.randn(B, t, H, d), jnp.float32) for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_whole_block_padding_masked(causal):
+    """block_q != block_k can pad by WHOLE K blocks even when T divides
+    block_k (lcm rounding: T=384, bq=256, bk=128 -> t_pad=512); those
+    blocks must be masked or padded zero-keys get softmax weight."""
+    rng = np.random.RandomState(9)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 384, 2, 64), jnp.float32)
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=causal, block_q=256,
+                          block_k=128, interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+    gf = jax.grad(
+        lambda q: (flash_attention(q, k, v, causal=causal, block_q=256,
+                                   block_k=128, interpret=True) ** 2).sum()
+    )(q)
+    gr = jax.grad(
+        lambda q: (reference_attention(q, k, v, causal=causal) ** 2).sum()
+    )(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=2e-4, atol=1e-4)
+
+
+def test_ragged_tail_with_custom_blocks():
+    rng = np.random.RandomState(6)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 200, 2, 128), jnp.float32)
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=128,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
 
 
 def test_scale_override():
@@ -81,3 +138,53 @@ def test_cross_attention_shapes_fall_back():
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_dense(causal):
+    """The custom-VJP backward kernels (FlashAttention-2 style: dK/dV over
+    Q tiles, dQ over K tiles, probabilities recomputed from the saved
+    logsumexp) must match autodiff through the dense path."""
+    q, k, v = qkv(7)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal,
+                                interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    # atol: analytically-zero entries (e.g. causal row 0, where
+    # ds = p*(dp - D) cancels exactly) accumulate ~1e-5 float noise
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-4,
+            err_msg=f"d{name} causal={causal}",
+        )
+
+
+@pytest.mark.parametrize("t,d", [(100, 128), (257, 64)])
+def test_backward_ragged_tails(t, d):
+    """Gradients through padded-masked tiles: padding must contribute
+    exactly zero gradient and real positions must match dense autodiff."""
+    rng = np.random.RandomState(8)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, t, 2, d), jnp.float32) for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=f"d{name} t={t} d={d}",
+        )

@@ -290,3 +290,77 @@ def test_bfrun_version():
     )
     assert out.returncode == 0
     assert out.stdout.strip()
+
+
+def test_ibfrun_start_executes_env_contract(tmp_path):
+    """``ibfrun-tpu start -np 4 <cmd>`` must exec the child with the
+    launcher env contract applied (worker count, dev platform) and the
+    stall watchdog defaulted OFF for interactive think time."""
+    import subprocess
+    import sys
+
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "assert os.environ['BLUEFOG_NUM_WORKERS'] == '4', os.environ\n"
+        "assert os.environ['BLUEFOG_STALL_TIMEOUT'] == '0', os.environ\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bluefog_tpu as bf\n"
+        "bf.init()\n"
+        "assert bf.size() == 4, bf.size()\n"
+        "import numpy as np\n"
+        "x = bf.worker_values(lambda r: np.full((2,), float(r), np.float32))\n"
+        "for _ in range(20):\n"
+        "    x = bf.neighbor_allreduce(x)\n"
+        "mse = float(np.mean((np.asarray(x) - 1.5) ** 2))\n"
+        "assert mse < 1e-6, mse\n"
+        "bf.suspend(); bf.resume(); bf.shutdown()\n"
+        "print('IBFRUN_OK')\n"
+    )
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.interactive_run",
+         "start", "-np", "4", "--platform", "cpu",
+         sys.executable, str(probe)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "IBFRUN_OK" in out.stdout
+
+
+def test_interactive_notebook_cells_execute(tmp_path):
+    """The committed notebook example (reference
+    examples/interactive_bluefog_helloworld.ipynb analogue) must stay
+    runnable: execute its code cells in order in a child interpreter
+    under the ibfrun env contract."""
+    import json
+    import subprocess
+    import sys
+
+    nb_path = os.path.join(REPO, "examples", "interactive_helloworld.ipynb")
+    with open(nb_path) as f:
+        nb = json.load(f)
+    cells = [
+        "".join(c["source"]) for c in nb["cells"]
+        if c["cell_type"] == "code"
+    ]
+    script = tmp_path / "nb.py"
+    script.write_text("\n\n".join(cells))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.interactive_run",
+         "start", "-np", "8", "--platform", "cpu",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done" in out.stdout

@@ -62,6 +62,7 @@ start = float(np.asarray(loss_fn(params["w"], c.mean(0))))
 for _ in range(50):
     grads = {"w": grad_fn(params["w"], c)}
     params, state = opt.step(params, state, grads)
+    jax.block_until_ready(params["w"])  # CPU Gloo rendezvous: don't queue deep
 final = float(np.asarray(loss_fn(params["w"], c.mean(0))))
 # CTA gossip with a constant step size keeps a steady-state consensus
 # residual; 5x loss reduction proves communication is really averaging
@@ -79,11 +80,32 @@ hstate = hopt.init(hparams)
 for _ in range(40):
     hgrads = {"w": grad_fn(hparams["w"], c)}
     hparams, hstate = hopt.step(hparams, hstate, hgrads)
+    jax.block_until_ready(hparams["w"])
 hfinal = float(np.asarray(loss_fn(hparams["w"], c.mean(0))))
 assert hfinal < 0.2 * start, (start, hfinal)
 
+# window family across REAL controller processes: push-sum diffusion on a
+# directed ring over the global mesh. The window's value/buffer/p lanes
+# are worker-stacked arrays sharded across devices owned by BOTH
+# processes, so every buffered ppermute exchange crosses the process
+# boundary — the one surface the gossip legs above don't touch.
+bf.set_topology(tu.RingGraph(SIZE, connect_style=1), is_weighted=True)
+wopt = bf.DistributedPushSumOptimizer(
+    optax.sgd(optax.exponential_decay(0.4, 20, 0.5))
+)
+wparams = {"w": jnp.asarray(c)}
+wstate = wopt.init(wparams)
+cur = wparams
+for _ in range(60):
+    cur, wstate = wopt.step(wstate, {"w": grad_fn(cur["w"], c)})
+    jax.block_until_ready(cur["w"])
+wfinal = float(np.asarray(loss_fn(cur["w"], c.mean(0))))
+assert wfinal < 0.2 * start, (start, wfinal)
+wopt.free()
+bf.turn_off_win_ops_with_associated_p()
+
 bf.shutdown()
-print("MP_OK", jax.process_index(), start, final, flush=True)
+print("MP_OK", jax.process_index(), start, final, hfinal, wfinal, flush=True)
 """
 
 
@@ -135,6 +157,11 @@ def test_two_controller_processes_end_to_end(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, err[-3000:]
         assert "MP_OK" in out, (out, err[-2000:])
-    # Both controllers converged to the same consensus loss.
-    finals = {o.split()[-1] for _rc, o, _e in outs for o in [o.strip().splitlines()[-1]]}
+    # Both controllers converged to the same consensus losses (gossip,
+    # hierarchical, AND push-sum window legs — the last three tokens).
+    finals = {
+        tuple(o.split()[-3:])
+        for _rc, o, _e in outs
+        for o in [o.strip().splitlines()[-1]]
+    }
     assert len(finals) == 1, outs

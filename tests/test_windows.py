@@ -362,3 +362,50 @@ def test_push_sum_consensus():
     assert float(np.sum(p)) == pytest.approx(SIZE, abs=1e-3)
     corrected = np.asarray(out)[:, 0] / p
     np.testing.assert_allclose(corrected, vals.mean(), atol=1e-3)
+
+
+def test_host_weight_resolution_cost():
+    """Pin the window optimizer's per-step host-side weight resolution at
+    the BASELINE north-star scale (v5e-256): the structure-keyed caches
+    must make the warm path well under the device step time. Bound is
+    generous (10 ms vs ~0.6 ms measured) to ride out CI noise; the real
+    assertion is that repeated calls add NO new cache entries (all
+    O(size^2) lowering work happened once)."""
+    import time
+    import types
+
+    from bluefog_tpu import topology as topo_mod
+    from bluefog_tpu import windows as win_mod
+
+    size = 256
+    g = topo_mod.ExponentialTwoGraph(size)
+    in_nbrs = tuple(
+        tuple(sorted(int(s) for s in g.predecessors(r) if s != r))
+        for r in range(size)
+    )
+    out_nbrs = tuple(
+        tuple(sorted(int(d) for d in g.successors(r) if d != r))
+        for r in range(size)
+    )
+    max_deg = max(len(s) for s in in_nbrs)
+    ctx = types.SimpleNamespace(size=size, op_cache={})
+    win = types.SimpleNamespace(
+        in_neighbors=in_nbrs, max_deg=max_deg, name="pin", shape=(4,)
+    )
+
+    def resolve_once():
+        w, part = win_mod._per_rank_edges(ctx, None, out_nbrs, "dst_weights")
+        win_mod._self_weight_vec(ctx, None, part)
+        perms, _slots = win_mod._lowered_exchange(ctx, win, w)
+        win_mod._round_weights(perms, w)
+        win_mod._slot_weights(win, w.T, size)
+
+    resolve_once()  # cold: builds the structure caches
+    n_keys = len(ctx.op_cache)
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        resolve_once()
+    per_step = (time.perf_counter() - t0) / reps
+    assert len(ctx.op_cache) == n_keys, "warm calls must not re-lower"
+    assert per_step < 0.010, f"host weight resolution {per_step*1e3:.2f} ms"
