@@ -84,6 +84,23 @@ def _block_scores(q, k, scale):
     return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
 
 
+def _merge_blocks(out_a, lse_a, out_b, lse_b):
+    """Exactly combine two normalized attention results over disjoint key
+    blocks, given their logsumexps (the online-softmax merge rule).
+    ``out``: [b, t, h, d] f32; ``lse``: [b, h, t]. lse=-inf marks an
+    empty/excluded block (weight zero)."""
+    m = jnp.maximum(lse_a, lse_b)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    wa = jnp.where(jnp.isfinite(lse_a), jnp.exp(lse_a - m_safe), 0.0)
+    wb = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - m_safe), 0.0)
+    tot = wa + wb
+    tot_safe = jnp.where(tot > 0, tot, 1.0)
+    tr = lambda w: (w / tot_safe).transpose(0, 2, 1)[..., None]
+    out = tr(wa) * out_a + tr(wb) * out_b
+    lse = jnp.where(tot > 0, m_safe + jnp.log(tot_safe), -jnp.inf)
+    return out, lse
+
+
 def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None):
     """Ring attention on per-worker blocks, for use inside ``shard_map``.
@@ -98,69 +115,57 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
     the COMPACT K/V around the ring and expands per round on the
     receiver, so GQA also divides the ring's wire bytes by the group
     factor.
+
+    The per-round block attention runs through the Pallas flash kernels
+    on TPU (``flash_attention_with_lse``; dense XLA elsewhere, selected
+    per lowering platform — the ppermute transport stays OUTSIDE any
+    platform branch since dead collectives are not DCE'd). Causal
+    structure is resolved per round without traced kernel configs: the
+    diagonal block is always round 0 (static causal kernel); every later
+    round's block is wholly past or wholly future of this worker, so it
+    enters the online-softmax merge with its logsumexp gated to -inf
+    when excluded.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    from bluefog_tpu.ops.flash import flash_attention_with_lse
+
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, t, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # online-softmax state: running max m, normalizer l, accumulator in f32.
-    # The constants must be marked device-varying or the fori_loop carry
-    # types mismatch under shard_map's varying-axis tracking.
-    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-
-    def _vary(x):
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, axes, to="varying")
-        return lax.pvary(x, axes)  # older JAX spelling
-
-    acc0 = _vary(jnp.zeros((b, t, h, d), jnp.float32))
-    m0 = _vary(jnp.full((b, h, t), -jnp.inf, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, t), jnp.float32))
-
-    def attend(r, kcur, vcur, acc, m, l):
-        src = (my - r) % n  # whose K/V block this worker holds this round
+    def block_attend(kcur, vcur, block_causal):
         kx, vx = _expand_kv(q, kcur), _expand_kv(q, vcur)
-        s = _block_scores(q, kx, scale).astype(jnp.float32)  # [b,h,t,t]
-        if causal:
-            qpos = my * t + jnp.arange(t)
-            kpos = src * t + jnp.arange(t)
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(-1))
-        # fully-masked rows keep m=-inf; guard exp(-inf - -inf)
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - m_safe[..., None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        corr = jnp.where(
-            jnp.isfinite(m), jnp.exp(m - m_safe), jnp.zeros_like(m)
+        out, lse = flash_attention_with_lse(
+            q, kx, vx, causal=block_causal, scale=scale
         )
-        l = l * corr + p.sum(-1)
-        acc = (
-            acc * corr.transpose(0, 2, 1)[..., None]
-            + jnp.einsum("bhqk,bkhd->bqhd", p, vx.astype(jnp.float32))
-        )
-        return acc, m_new, l
+        return out.astype(jnp.float32), lse
+
+    # round 0: own block — the diagonal, the only block needing intra-
+    # block causal masking (statically known, so the kernel config is
+    # static too). The accumulators inherit device-varyingness from
+    # q/k/v, so the fori_loop carry types line up without pvary.
+    out_acc, lse_acc = block_attend(k, v, causal)
 
     def round_fn(r, carry):
-        kcur, vcur, acc, m, l = carry
-        acc, m, l = attend(r, kcur, vcur, acc, m, l)
+        kcur, vcur, out_acc, lse_acc = carry
         kcur = lax.ppermute(kcur, axis_name, perm)
         vcur = lax.ppermute(vcur, axis_name, perm)
-        return kcur, vcur, acc, m, l
+        # after r rotations this worker holds block (my - r) mod n: for
+        # r >= 1 it is never the diagonal, so it is wholly past (keep,
+        # unmasked) or wholly future (gate out via lse=-inf) of my rows
+        src = (my - r) % n
+        out_b, lse_b = block_attend(kcur, vcur, False)
+        if causal:
+            lse_b = jnp.where(src < my, lse_b, -jnp.inf)
+        out_acc, lse_acc = _merge_blocks(out_acc, lse_acc, out_b, lse_b)
+        return kcur, vcur, out_acc, lse_acc
 
-    # n-1 (attend, rotate) rounds, then a final attend with NO rotation:
-    # the last permute's result would be discarded, and inside the loop
-    # XLA cannot DCE a collective — at n=2 it would double the traffic.
-    kcur, vcur, acc, m, l = lax.fori_loop(
-        0, n - 1, round_fn, (k, v, acc0, m0, l0)
+    _kcur, _vcur, out_acc, lse_acc = lax.fori_loop(
+        1, n, round_fn, (k, v, out_acc, lse_acc)
     )
-    acc, m, l = attend(n - 1, kcur, vcur, acc, m, l)
-    lsafe = jnp.where(l > 0, l, 1.0)
-    out = acc / lsafe.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    return out_acc.astype(q.dtype)
 
 
 def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,

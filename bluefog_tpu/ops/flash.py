@@ -38,7 +38,11 @@ try:  # pltpu is importable on CPU builds too; guard anyway
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["flash_attention", "flash_attention_supported"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "flash_attention_supported",
+]
 
 _LANES = 128
 # lse/delta row vectors ride in [bh, t_pad, _SUB] tensors: Mosaic requires
@@ -145,8 +149,9 @@ def _vma(x):
 
 
 def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
-              interpret):
+              interpret, out_dtype=None):
     bh, t_pad, d_pad = qf.shape
+    out_dtype = qf.dtype if out_dtype is None else out_dtype
     vma = _vma(qf)
     grid = (bh, t_pad // block_q, t_pad // block_k)
     return pl.pallas_call(
@@ -155,7 +160,7 @@ def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
             block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t_pad, d_pad), qf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), out_dtype, vma=vma),
             jax.ShapeDtypeStruct((bh, t_pad, _SUB), jnp.float32, vma=vma),
         ),
         grid=grid,
@@ -200,7 +205,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, iq, ik, scale, causal, block_q,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale, causal, block_q, block_k, kv_len, t_pad):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
@@ -226,7 +231,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, 0][:, None]) * scale
+        # dlse: upstream cotangent on the logsumexp output (zero for
+        # plain flash_attention; nonzero when lse feeds a cross-block
+        # merge, e.g. ring attention) — dL/ds_ij picks up dlse_i * p_ij
+        ds = p * (
+            dp - delta_ref[0][:, 0][:, None] + dlse_ref[0][:, 0][:, None]
+        ) * scale
         # dK += dS^T Q
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
@@ -245,7 +255,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc,
+                   dlse_ref, dq_ref, dq_acc,
                    *, scale, causal, block_q, block_k, kv_len, t_pad):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
@@ -264,7 +274,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0][:, 0][:, None]) * scale
+        ds = p * (
+            dp - delta_ref[0][:, 0][:, None] + dlse_ref[0][:, 0][:, None]
+        ) * scale
         # dQ += dS K
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
@@ -282,13 +294,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
-              kv_len, interpret):
+              kv_len, interpret, dlse=None):
     bh, t_pad, d_pad = qf.shape
     # D_i = rowsum(dO_i * O_i) — O(T d) elementwise, fine in XLA
     delta = jnp.sum(
         do.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
     )
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_SUB,))
+    if dlse is None:
+        dlse_w = jnp.zeros_like(delta)
+    else:
+        dlse_w = jnp.broadcast_to(
+            dlse.astype(jnp.float32)[..., None], dlse.shape + (_SUB,)
+        )
     vma = _vma(qf)
     q_spec = pl.BlockSpec((1, block_q, d_pad), lambda b, ik, iq: (b, iq, 0))
     k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0))
@@ -303,7 +321,7 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((bh, t_pad, d_pad), vf.dtype, vma=vma),
         ),
         grid=(bh, t_pad // block_k, t_pad // block_q),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec, r_spec],
         out_specs=(
             pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
@@ -313,7 +331,7 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, d_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, do, lse, delta)
+    )(qf, kf, vf, do, lse, delta, dlse_w)
     q_spec2 = pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0))
     r_spec2 = pl.BlockSpec((1, block_q, _SUB), lambda b, iq, ik: (b, iq, 0))
@@ -325,13 +343,14 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qf.dtype,
                                        vma=vma),
         grid=(bh, t_pad // block_q, t_pad // block_k),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2,
+                  r_spec2],
         out_specs=pl.BlockSpec(
             (1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)
         ),
         scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, do, lse, delta)
+    )(qf, kf, vf, do, lse, delta, dlse_w)
     return dq, dk, dv
 
 
@@ -365,6 +384,126 @@ def _flash_fn(causal, scale, block_q, block_k, kv_len, interpret):
 
     f.defvjp(f_fwd, f_bwd)
     return f
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_lse_fn(causal, scale, block_q, block_k, kv_len, interpret):
+    """Like :func:`_flash_fn` but returns ``(out, lse)`` with a joint VJP:
+    the backward receives ``(do, dlse)`` and folds the lse cotangent into
+    ``ds`` (``dlse_i * p_ij``). This is the building block for cross-block
+    online-softmax merges (ring attention): each block's normalized output
+    plus its logsumexp is enough to combine blocks exactly."""
+
+    @jax.custom_vjp
+    def f(qf, kf, vf):
+        return _fwd_call(
+            qf, kf, vf, causal, scale, block_q, block_k, kv_len,
+            interpret, out_dtype=jnp.float32,
+        )
+
+    def f_fwd(qf, kf, vf):
+        out, lse = _fwd_call(
+            qf, kf, vf, causal, scale, block_q, block_k, kv_len,
+            interpret, out_dtype=jnp.float32,
+        )
+        return (out, lse), (qf, kf, vf, out, lse)
+
+    def f_bwd(res, cts):
+        do, dlse = cts
+        qf, kf, vf, out, lse = res
+        # dlse arrives [bh, t_pad, _SUB] (broadcast rows); one lane is the
+        # true cotangent sum across the broadcast
+        dlse_row = dlse.sum(axis=-1)
+        return _bwd_call(
+            qf, kf, vf, out, lse, do, causal, scale, block_q, block_k,
+            kv_len, interpret, dlse=dlse_row,
+        )
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Padded/folded kernel invocation returning ``(out, lse)`` in the
+    caller's layout: out ``[b, t, h, d]``, lse ``[b, h, t]`` (f32)."""
+    b, t, h, d = q.shape
+    if block_q is None:
+        block_q = _auto_block(t)
+    if block_k is None:
+        block_k = block_q
+    tile = int(np.lcm(block_q, block_k))
+    t_pad = -(-t // tile) * tile
+    qp, kp, vp = (_pad_to(x, t_pad, d) for x in (q, k, v))
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d)
+    fn = _flash_lse_fn(causal, float(scale), block_q, block_k, t, interpret)
+    out, lse = fn(fold(qp), fold(kp), fold(vp))
+    out = out.reshape(b, h, t_pad, d).transpose(0, 2, 1, 3)[:, :t]
+    lse = lse[:, :, 0].reshape(b, h, t_pad)[:, :, :t]
+    return out, lse
+
+
+def _dense_with_lse(q, k, v, causal, scale):
+    """Dense XLA attention returning ``(out f32, lse)`` — the fallback
+    branch and the CPU oracle for the lse-carrying kernel path. K/V with
+    fewer heads than Q run grouped-query attention, same as
+    :func:`reference_attention`."""
+    from bluefog_tpu.ops.attention import _expand_kv
+
+    k, v = _expand_kv(q, k), _expand_kv(q, v)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = s.max(-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = p.sum(-1)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", (p / l_safe[..., None]),
+        v.astype(jnp.float32),
+    )
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), _NEG_INF)
+    return out, lse  # out stays f32: block results merge in f32
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
+                             interpret: bool = False):
+    """Self-attention returning ``(out [b,t,h,d] f32, lse [b,h,t] f32)``.
+
+    The logsumexp output makes per-block results mergeable across blocks
+    (online-softmax combination), which is what ring attention needs to
+    run each round's block attention through the Pallas kernels; ``out``
+    is f32 so an n-round merge never round-trips the accumulator through
+    bf16. Differentiable in both outputs. Kernel path on TPU, dense
+    otherwise (selected per lowering platform)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if (
+        pltpu is None
+        or tuple(k.shape) != tuple(q.shape)
+        or tuple(v.shape) != tuple(q.shape)
+    ):
+        return _dense_with_lse(q, k, v, causal, scale)
+    if interpret:
+        return _flash_with_lse(q, k, v, causal, float(scale), block_q,
+                               block_k, True)
+    return jax.lax.platform_dependent(
+        q, k, v,
+        tpu=lambda q, k, v: _flash_with_lse(
+            q, k, v, causal, float(scale), block_q, block_k, False
+        ),
+        default=lambda q, k, v: _dense_with_lse(q, k, v, causal, scale),
+    )
 
 
 def flash_attention_supported(q, k=None, v=None, *, block_q: int = 128,

@@ -29,7 +29,6 @@ The TPU-native analogue has two parts:
 """
 
 import re
-import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -204,7 +203,7 @@ def weak_scaling_times(
     no-op on remote-tunneled platforms). Per-worker work must be constant
     across ``ns`` (weak scaling), so ``efficiency = t[0] / t[n]``.
     """
-    from bluefog_tpu.timing import settle
+    from bluefog_tpu.timing import timed_differenced
 
     out = []
     t1 = None
@@ -213,13 +212,7 @@ def weak_scaling_times(
         fn, args = make_step(mesh)
         for _ in range(warmup):
             res = fn(*args)
-        settle(res)
-        settle(res)  # warm the gather's own compile for this aval
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            res = fn(*args)
-        settle(res)
-        dt = (time.perf_counter() - t0) / steps
+        dt = timed_differenced(lambda: fn(*args), steps, windows=2)[0]
         if t1 is None:
             t1 = dt
         out.append(

@@ -11,9 +11,42 @@ gather producing a FRESH scalar device array each call, then one host
 transfer.
 """
 
-__all__ = ["settle"]
+__all__ = ["settle", "timed_differenced"]
 
 _TAKE = None
+
+
+def timed_differenced(step, steps: int, windows: int):
+    """Differenced-window timing: per window, time ``steps`` calls +
+    settle and ``2*steps`` calls + settle; the difference is ``steps``
+    calls of pure compute with the settle RTT (~100 +-50 ms through the
+    tunnel) cancelled EXACTLY — the single-window readback correction
+    used through round 4 cancelled it only in expectation and swung
+    results several % either way.
+
+    ``step()`` advances whatever state it closes over and returns the
+    settle target (keep it SCALAR — settling a large tensor pays the
+    tunnel transfer). Returns the per-call times of all windows, sorted
+    ascending (``[0]`` is the best window; the spread is the honest
+    noise disclosure)."""
+    import time
+
+    out = step()
+    settle(out)
+    settle(out)  # warm the readback path's own compile
+    dts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step()
+        settle(out)
+        t1 = time.perf_counter()
+        for _ in range(2 * steps):
+            out = step()
+        settle(out)
+        t2 = time.perf_counter()
+        dts.append(max((t2 - t1) - (t1 - t0), 1e-9) / steps)
+    return sorted(dts)
 
 
 def settle(x) -> float:

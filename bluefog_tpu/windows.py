@@ -555,10 +555,21 @@ def _update_weights(ctx, win, self_weight, neighbor_weights):
         return self_vec, w_recv, participating
     # default resolution depends only on the window topology and the
     # context topology generation — cache it (the per-rank weight loops +
-    # validation are per-step host work otherwise)
+    # validation are per-step host work otherwise). One entry per window
+    # topology: alternating set_topology calls bump topo_version every
+    # time, so stale-version entries are evicted rather than accumulated
+    # (~MBs each at large size). In-place mutation of the graph object
+    # from load_topology() is NOT detected — call set_topology to change
+    # weights (it is the documented mutation point and bumps the version).
     key = ("win_update_weights", win.in_neighbors, ctx.topo_version)
     cached = ctx.op_cache.get(key)
     if cached is None:
+        for stale in [
+            k for k in ctx.op_cache
+            if isinstance(k, tuple) and len(k) == 3
+            and k[0] == "win_update_weights" and k[1] == win.in_neighbors
+        ]:
+            del ctx.op_cache[stale]
         participating = np.ones(size, bool)
         topo = ctx.load_topology()
         w_recv = np.zeros((size, size))

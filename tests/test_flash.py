@@ -188,3 +188,68 @@ def test_backward_ragged_tails(t, d):
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
             err_msg=f"d{name} t={t} d={d}",
         )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_with_lse_matches_dense_including_lse_gradient(causal):
+    """flash_attention_with_lse: both outputs match the dense oracle, and
+    the joint VJP (the dlse term folded into ds) matches dense autodiff
+    through a loss that uses out AND lse."""
+    from bluefog_tpu.ops.flash import (
+        _dense_with_lse,
+        flash_attention_with_lse,
+    )
+
+    rng = np.random.RandomState(11)
+    t, d = 200, 64  # ragged tail: padded rows must carry lse=-inf
+    q, k, v = (
+        jnp.asarray(rng.randn(1, t, 2, d), jnp.float32) for _ in range(3)
+    )
+    out, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                        interpret=True)
+    out_r, lse_r = _dense_with_lse(q, k, v, causal, 1.0 / np.sqrt(d))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_r),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_of(fn):
+        def loss(q, k, v):
+            o, l = fn(q, k, v)
+            return (o ** 2).sum() + (jnp.tanh(l) * 0.3).sum()
+        return loss
+
+    gf = jax.grad(loss_of(lambda q, k, v: flash_attention_with_lse(
+        q, k, v, causal=causal, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_of(lambda q, k, v: _dense_with_lse(
+        q, k, v, causal, 1.0 / np.sqrt(d))), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-4,
+            err_msg=f"d{name} causal={causal}",
+        )
+
+
+def test_merge_blocks_reassembles_full_attention():
+    """The online-softmax merge rule: attending two key blocks separately
+    and merging (out, lse) pairs equals attending the concatenation."""
+    from bluefog_tpu.ops.attention import _merge_blocks
+    from bluefog_tpu.ops.flash import _dense_with_lse
+
+    rng = np.random.RandomState(12)
+    q = jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32)
+    k1, v1, k2, v2 = (
+        jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32) for _ in range(4)
+    )
+    s = 1.0 / np.sqrt(8)
+    o1, l1 = _dense_with_lse(q, k1, v1, False, s)
+    o2, l2 = _dense_with_lse(q, k2, v2, False, s)
+    merged, _ = _merge_blocks(
+        o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2
+    )
+    full, _ = _dense_with_lse(
+        q, jnp.concatenate([k1, k2], 1), jnp.concatenate([v1, v2], 1),
+        False, s,
+    )
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                               rtol=2e-5, atol=2e-6)

@@ -17,7 +17,6 @@ docs/performance.md.
 """
 
 import json
-import time
 
 import numpy as np
 import jax
@@ -25,7 +24,7 @@ import jax.numpy as jnp
 import optax
 
 from bluefog_tpu.models.resnet import ResNet, BottleneckBlock
-from bluefog_tpu.timing import settle
+from bluefog_tpu.timing import timed_differenced
 
 BATCH = 64
 IMAGE = 224
@@ -46,24 +45,13 @@ _PEAK = 197e12  # v5e dense bf16
 
 
 def timed(fn, state0, x, steps=STEPS, windows=WINDOWS):
-    state = fn(state0, x)
-    settle(state[-1])
-    settle(state[-1])
-    best = None
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state = fn(state, x)
-        settle(state[-1])
-        t1 = time.perf_counter()
-        for _ in range(2 * steps):
-            state = fn(state, x)
-        settle(state[-1])
-        t2 = time.perf_counter()
-        dt = max((t2 - t1) - (t1 - t0), 1e-9) / steps
-        if best is None or dt < best:
-            best = dt
-    return best
+    carry = [state0]
+
+    def _step():
+        carry[0] = fn(carry[0], x)
+        return carry[0][-1]  # the scalar loss
+
+    return timed_differenced(_step, steps, windows)[0]
 
 
 def main():
