@@ -506,9 +506,10 @@ def run_transformer() -> int:
     steps = max(1, int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "2")))
     windows = max(1, int(os.environ.get("BENCH_WINDOWS", "8" if on_tpu else "1")))
 
+    remat = os.environ.get("BENCH_TLM_REMAT", "0") == "1"
     model = TransformerLM(
         vocab=vocab, dim=dim, heads=heads, layers=layers, max_len=seq,
-        dtype=jnp.bfloat16,
+        dtype=jnp.bfloat16, remat=remat,
     )
     rng_np = np.random.RandomState(0)
     tokens = jnp.asarray(
@@ -553,7 +554,7 @@ def run_transformer() -> int:
         "seq_len": seq,
         "params_m": round(n_params / 1e6, 1),
         "dim": dim, "heads": heads, "layers": layers, "batch": batch,
-        "attention": "pallas_flash",
+        "attention": "pallas_flash", "remat": remat,
     }
     peak = _peak_flops(jax.devices()[0])
     if peak:

@@ -1,0 +1,53 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""TransformerLM model-level pins (the sequence-parallel equivalences
+live in tests/test_attention.py; the training e2e in the long_context
+example)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bluefog_tpu.models.transformer import TransformerLM
+
+
+def _model(**kw):
+    return TransformerLM(vocab=64, dim=32, heads=4, layers=2, max_len=128,
+                         **kw)
+
+
+def test_remat_is_numerically_invisible():
+    """remat=True must change memory behavior only: same params, same
+    logits, same gradients as the plain model."""
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (2, 96)), jnp.int32
+    )
+    plain, remat = _model(), _model(remat=True)
+    params = plain.init(jax.random.PRNGKey(0), tokens)["params"]
+    # identical parameter structure: remat wraps the module, not the math
+    params_r = remat.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert jax.tree_util.tree_structure(params) == (
+        jax.tree_util.tree_structure(params_r)
+    )
+
+    def loss(model, p):
+        return (
+            model.apply({"params": p}, tokens).astype(jnp.float32) ** 2
+        ).mean()
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(plain, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_static_pos_offset_overflow_refused():
+    tokens = jnp.zeros((1, 100), jnp.int32)
+    model = _model()
+    with pytest.raises(ValueError, match="max_len"):
+        model.init(jax.random.PRNGKey(0), tokens, pos_offset=64)
