@@ -112,9 +112,9 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
     with f32 online-softmax accumulation (reductions are reordered vs a
     dense computation, so equality is numerical — rtol ~1e-5 at f32 —
     not bitwise). Grouped-query attention (K/V with fewer heads) rotates
-    the COMPACT K/V around the ring and expands per round on the
-    receiver, so GQA also divides the ring's wire bytes by the group
-    factor.
+    the COMPACT K/V around the ring AND keeps it compact inside the
+    kernels (no receiver-side expansion), so GQA divides both the ring's
+    wire bytes and the block-attention HBM traffic by the group factor.
 
     The per-round block attention runs through the Pallas flash kernels
     on TPU (``flash_attention_with_lse``; dense XLA elsewhere, selected
@@ -136,9 +136,12 @@ def ring_attention_block(q, k, v, axis_name: str, causal: bool = False,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def block_attend(kcur, vcur, block_causal):
-        kx, vx = _expand_kv(q, kcur), _expand_kv(q, vcur)
+        # compact (grouped-query) K/V goes straight in: the kernels serve
+        # each KV head to its query group from the index maps, and the
+        # dense fallback expands internally — no receiver-side expanded
+        # copy exists on either path
         out, lse = flash_attention_with_lse(
-            q, kx, vx, causal=block_causal, scale=scale
+            q, kcur, vcur, causal=block_causal, scale=scale
         )
         return out.astype(jnp.float32), lse
 
@@ -208,12 +211,10 @@ def ulysses_attention_block(q, k, v, axis_name: str, causal: bool = False,
         )
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # expand a compact-resharded KV locally: the wire stayed compact, and
-    # matching head counts keep the Pallas flash kernel eligible (its
-    # support predicate requires equal Q/KV shapes)
-    kf, vf = _expand_kv(qf, kf), _expand_kv(qf, vf)
-    # local attention hot op: Pallas flash kernel on TPU when the tiling
-    # allows, dense XLA otherwise (same math; see ops/flash.py)
+    # local attention hot op: Pallas flash kernels on TPU, dense XLA
+    # otherwise (same math; see ops/flash.py). A compact-resharded KV
+    # stays compact end to end: the wire was compact, and the kernels
+    # serve grouped-query heads natively from their index maps.
     from bluefog_tpu.ops.flash import flash_attention
 
     out = flash_attention(qf, kf, vf, causal=causal, scale=scale)

@@ -151,9 +151,16 @@ def _vma(x):
 def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
               interpret, out_dtype=None):
     bh, t_pad, d_pad = qf.shape
+    # grouped-query attention: folded KV carries b*h_kv leading slots; a
+    # KV head serves its whole query group straight from the index map —
+    # no expanded copy ever exists
+    group = bh // kf.shape[0]
     out_dtype = qf.dtype if out_dtype is None else out_dtype
     vma = _vma(qf)
     grid = (bh, t_pad // block_q, t_pad // block_k)
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d_pad), lambda b, iq, ik: (b // group, ik, 0)
+    )
     return pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
@@ -166,8 +173,8 @@ def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0)),
@@ -208,9 +215,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale, causal, block_q, block_k, kv_len, t_pad):
     ik = pl.program_id(1)
-    iq = pl.program_id(2)
+    # the inner grid dim enumerates (query head of the group, q tile):
+    # with grouped-query attention one KV head accumulates dK/dV over
+    # every query head it serves; iq is the tile index within one head
+    iq2 = pl.program_id(2)
+    n_q = t_pad // block_q
+    iq = iq2 % n_q
 
-    @pl.when(iq == 0)
+    @pl.when(iq2 == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -248,7 +260,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _tile()
 
-    @pl.when(iq == pl.num_programs(2) - 1)
+    @pl.when(iq2 == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -308,23 +320,35 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
             dlse.astype(jnp.float32)[..., None], dlse.shape + (_SUB,)
         )
     vma = _vma(qf)
-    q_spec = pl.BlockSpec((1, block_q, d_pad), lambda b, ik, iq: (b, iq, 0))
-    k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0))
-    r_spec = pl.BlockSpec((1, block_q, _SUB), lambda b, ik, iq: (b, iq, 0))
+    bh_kv = kf.shape[0]
+    group = bh // bh_kv
+    n_q = t_pad // block_q
+    # dK/dV grid: (kv head, k tile, group member x q tile) — the inner
+    # dim walks every query head served by this KV head, so the group
+    # reduction happens in the VMEM accumulator with no expanded copy
+    q_gqa = pl.BlockSpec(
+        (1, block_q, d_pad),
+        lambda b, ik, iq2: (b * group + iq2 // n_q, iq2 % n_q, 0),
+    )
+    r_gqa = pl.BlockSpec(
+        (1, block_q, _SUB),
+        lambda b, ik, iq2: (b * group + iq2 // n_q, iq2 % n_q, 0),
+    )
+    k_spec = pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq2: (b, ik, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t_pad, d_pad), kf.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, t_pad, d_pad), vf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh_kv, t_pad, d_pad), kf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh_kv, t_pad, d_pad), vf.dtype, vma=vma),
         ),
-        grid=(bh, t_pad // block_k, t_pad // block_q),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec, r_spec],
+        grid=(bh_kv, t_pad // block_k, group * n_q),
+        in_specs=[q_gqa, k_spec, k_spec, q_gqa, r_gqa, r_gqa, r_gqa],
         out_specs=(
-            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq2: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, ik, iq2: (b, ik, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_k, d_pad), jnp.float32),
@@ -333,7 +357,9 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qf, kf, vf, do, lse, delta, dlse_w)
     q_spec2 = pl.BlockSpec((1, block_q, d_pad), lambda b, iq, ik: (b, iq, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, d_pad), lambda b, iq, ik: (b, ik, 0))
+    k_spec2 = pl.BlockSpec(
+        (1, block_k, d_pad), lambda b, iq, ik: (b // group, ik, 0)
+    )
     r_spec2 = pl.BlockSpec((1, block_q, _SUB), lambda b, iq, ik: (b, iq, 0))
     dq = pl.pallas_call(
         functools.partial(
@@ -438,7 +464,9 @@ def _flash_with_lse(q, k, v, causal, scale, block_q, block_k, interpret):
     tile = int(np.lcm(block_q, block_k))
     t_pad = -(-t // tile) * tile
     qp, kp, vp = (_pad_to(x, t_pad, d) for x in (q, k, v))
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], t_pad, d
+    )
     fn = _flash_lse_fn(causal, float(scale), block_q, block_k, t, interpret)
     out, lse = fn(fold(qp), fold(kp), fold(vp))
     out = out.reshape(b, h, t_pad, d).transpose(0, 2, 1, 3)[:, :t]
@@ -488,11 +516,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     otherwise (selected per lowering platform)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    if (
-        pltpu is None
-        or tuple(k.shape) != tuple(q.shape)
-        or tuple(v.shape) != tuple(q.shape)
-    ):
+    if pltpu is None or not flash_attention_supported(q, k, v):
         return _dense_with_lse(q, k, v, causal, scale)
     if interpret:
         return _flash_with_lse(q, k, v, causal, float(scale), block_q,
@@ -510,13 +534,26 @@ def flash_attention_supported(q, k=None, v=None, *, block_q: int = 128,
                               block_k: int = 128) -> bool:
     """Kernel applicability: self-attention shapes only (one shared
     sequence length). Arbitrary sequence length and head_dim are handled
-    by padded-with-masking tiles — an O(T) copy, never an O(T²) dense
-    fallback — so only cross-attention / mismatched shapes fall back."""
+    by padded-with-masking tiles, and grouped-query K/V (fewer heads,
+    ``h % h_kv == 0``) is served natively from the index maps — so only
+    cross-attention (mismatched batch/seq/dim) falls back."""
     del block_q, block_k  # any T tiles via padding; kept for API compat
+    if q.ndim != 4 or q.shape[1] < 1:
+        return False
+    b, t, h, d = q.shape
     for other in (k, v):
-        if other is not None and tuple(other.shape) != tuple(q.shape):
+        if other is None:
+            continue
+        if other.ndim != 4:
+            return False
+        ob, ot, oh, od = other.shape
+        if (ob, ot, od) != (b, t, d) or oh < 1 or h % oh != 0:
             return False  # cross-attention / mismatched shapes: fall back
-    return q.ndim == 4 and q.shape[1] >= 1
+    if k is not None and v is not None and k.shape[2] != v.shape[2]:
+        # the kernels derive ONE group factor and share the KV index map;
+        # differing K/V head counts must take the dense path
+        return False
+    return True
 
 
 def _pad_to(x, t_pad, d_pad):
@@ -561,7 +598,11 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     t_pad = -(-t // tile) * tile
     d_pad = d
     qp, kp, vp = (_pad_to(x, t_pad, d_pad) for x in (q, k, v))
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t_pad, d_pad)
+    # fold by each tensor's OWN head count: grouped-query K/V stays
+    # compact all the way into the kernel
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], t_pad, d_pad
+    )
     fn = _flash_fn(causal, scale, block_q, block_k, t, interpret)
     out = fn(fold(qp), fold(kp), fold(vp))
     out = out.reshape(b, h, t_pad, d_pad).transpose(0, 2, 1, 3)
