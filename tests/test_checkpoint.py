@@ -235,3 +235,47 @@ def test_ef_restore_from_other_topology_safely_rezeros(tmp_path):
     assert opt2._ef is not installed  # sig mismatch -> rebuilt
     w = np.asarray(p2["w"])
     np.testing.assert_allclose(w, np.tile(w.mean(0), (SIZE, 1)), atol=5e-3)
+
+
+def test_num_steps_per_communication_resume_exact(tmp_path):
+    """A K>1 optimizer saved MID-accumulation-cycle resumes exactly: the
+    communication-phase counter and (for gradient order) the pending
+    gradient sum both ride the checkpoint."""
+    c = targets(9)
+    nonzero = {"w": bf.worker_values(
+        lambda r: np.full((DIM,), 0.5 + r, np.float32)
+    )}
+
+    def run(opt, params, state, n, path=None, save_at=None):
+        for i in range(n):
+            params, state = opt.step(params, state, nonzero)
+            if save_at is not None and i + 1 == save_at:
+                ckpt.save(str(path), i + 1, params, state, optimizer=opt)
+        return params, state
+
+    for factory in (
+        lambda: bf.DistributedGradientAllreduceOptimizer(
+            optax.sgd(0.1), num_steps_per_communication=3
+        ),
+        lambda: bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.1), num_steps_per_communication=3
+        ),
+    ):
+        path = tmp_path / factory().__class__.__name__
+        # uninterrupted: 4 + 5 steps (save lands mid-cycle: 4 % 3 != 0)
+        opt = factory()
+        params = {"w": bf.worker_values(lambda r: c[r])}
+        state = opt.init(params)
+        params, state = run(opt, params, state, 4, path, save_at=4)
+        p_ref, s_ref = run(opt, params, state, 5)
+
+        opt2 = factory()
+        params2 = {"w": bf.worker_values(lambda r: c[r])}
+        state2 = opt2.init(params2)
+        step, p2, s2 = ckpt.restore(str(path), optimizer=opt2)
+        assert step == 4
+        assert opt2._step_count == 4 and opt2._comm_count == 1
+        p2, s2 = run(opt2, p2, s2, 5)
+        np.testing.assert_array_equal(
+            np.asarray(p_ref["w"]), np.asarray(p2["w"])
+        )
