@@ -80,10 +80,6 @@ def reference_attention(q, k, v, causal: bool = False,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _block_scores(q, k, scale):
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-
-
 def _merge_blocks(out_a, lse_a, out_b, lse_b):
     """Exactly combine two normalized attention results over disjoint key
     blocks, given their logsumexps (the online-softmax merge rule).
