@@ -1,8 +1,13 @@
 # Copyright 2026. Licensed under the Apache License, Version 2.0.
 """Collective layer: topology-aware gossip collectives compiled to XLA.
 
-Two levels:
+Three levels:
 
+- :mod:`bluefog_tpu.collective.compiler` — the pass pipeline that packs a
+  directed edge set into ppermute rounds: offset grouping (the circulant
+  fast path), König edge-coloring round packing (provably minimal round
+  count for irregular graphs), and the alpha-beta cost model that picks
+  between them, memoized per edge set.
 - :mod:`bluefog_tpu.collective.plan` — host-side lowering of a (possibly
   dynamic, weighted, directed) virtual graph topology into a ``CommPlan``:
   rounds of partial permutations plus receiver-side weight vectors. This is
@@ -25,16 +30,21 @@ from bluefog_tpu.collective.plan import (
     schedule_from_dynamic,
     check_send_recv_symmetry,
 )
+from bluefog_tpu.collective.compiler import CompiledEdges, compile_edges
+from bluefog_tpu.collective import compiler
 from bluefog_tpu.collective import inner
 
 __all__ = [
     "CommPlan",
     "CommRound",
     "SchedulePlan",
+    "CompiledEdges",
+    "compile_edges",
     "plan_from_topology",
     "plan_from_weights",
     "plan_from_matrix",
     "schedule_from_dynamic",
     "check_send_recv_symmetry",
+    "compiler",
     "inner",
 ]

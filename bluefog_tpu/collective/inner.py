@@ -54,6 +54,11 @@ def weighted_combine(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndar
     entry in the round's weight vector (a tiny traced constant indexed by
     ``axis_index``). Partial permutations deliver zeros to non-destinations,
     whose weight entry is also zero, so irregular graphs need no masking.
+    The round structure is whatever the plan compiler chose
+    (:mod:`bluefog_tpu.collective.compiler`): offset-grouped circulant
+    rounds or the minimal edge coloring — both satisfy the only invariant
+    this combine relies on, that each rank receives from at most one
+    source per round.
     """
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
@@ -403,8 +408,11 @@ def allreduce(x: jnp.ndarray, axis_name: str, average: bool = True) -> jnp.ndarr
     if not average:
         return lax.psum(x, axis_name)
     wdt = _weight_dtype(x)
-    n = lax.psum(jnp.ones((), dtype=wdt), axis_name)
-    return lax.psum(x.astype(wdt), axis_name) / n
+    # psum of a literal is the STATIC axis size — no second collective on
+    # the wire (old XLA does not fold a psum-of-ones; new XLA does, but
+    # the packed-allreduce count assertions should not depend on it).
+    n = lax.psum(1, axis_name)
+    return lax.psum(x.astype(wdt), axis_name) / jnp.asarray(n, wdt)
 
 
 def allgather(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:

@@ -19,6 +19,7 @@ by rank (the controller sees every rank), not the reference's implicit
 
 import itertools
 import numbers
+import os
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -198,13 +199,24 @@ def _reject_flat_weight_dict(arg_name, value):
         )
 
 
+def _plan_method() -> str:
+    """Decomposition override for the comm-plan compiler: ``auto`` (the
+    cost-modeled default), ``offset`` or ``coloring`` — an A/B knob for
+    measuring the round-packing optimizer against the naive lowering
+    (see docs/plan_compiler.md)."""
+    return os.environ.get("BLUEFOG_PLAN_METHOD", "auto")
+
+
 def _static_plan(ctx) -> CommPlan:
     topo = ctx.load_topology()
     assert topo is not None, "no topology set; call bf.init()/bf.set_topology()"
-    key = ("static_plan", ctx.topo_version, ctx.is_topo_weighted())
+    method = _plan_method()
+    key = ("static_plan", ctx.topo_version, ctx.is_topo_weighted(), method)
     plan = ctx.op_cache.get(key)
     if plan is None:
-        plan = plan_from_topology(topo, weighted=ctx.is_topo_weighted())
+        plan = plan_from_topology(
+            topo, weighted=ctx.is_topo_weighted(), method=method
+        )
         ctx.op_cache[key] = plan
     return plan
 
@@ -263,6 +275,7 @@ def _resolve_plan(
         src_weights,
         dst_weights,
         enable_topo_check=enable_topo_check and dst_weights is not None,
+        method=_plan_method(),
     )
 
 
@@ -463,15 +476,17 @@ def hierarchical_neighbor_allreduce_nonblocking(
             "no machine topology set; call bf.set_machine_topology() or pass "
             "explicit machine weights"
         )
+        method = _plan_method()
         key = (
             "machine_plan",
             ctx.machine_topo_version,
             ctx.is_machine_topo_weighted(),
+            method,
         )
         mplan = ctx.op_cache.get(key)
         if mplan is None:
             mplan = plan_from_topology(
-                mtopo, weighted=ctx.is_machine_topo_weighted()
+                mtopo, weighted=ctx.is_machine_topo_weighted(), method=method
             )
             ctx.op_cache[key] = mplan
     else:

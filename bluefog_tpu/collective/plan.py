@@ -10,13 +10,17 @@ to a ``CommPlan`` — a short sequence of partial permutations
 (``lax.ppermute``) plus per-round receiver-side weight vectors — and the
 weighted combine compiles into the step function.
 
-Decomposition: every directed edge ``(src, dst)`` has a ring offset
-``(dst - src) % size``. All edges that share one offset form a partial
-permutation (sources are distinct, hence destinations too), so grouping by
-offset yields one ``ppermute`` per distinct offset. For the circulant
-topologies (Exp2, ring, fully-connected) each round is a *full* permutation
-— a single ``collective_permute`` riding ICI — and Exp-2 needs only
-``log2(N)`` rounds.
+Decomposition is a compiler choice (:mod:`bluefog_tpu.collective.compiler`):
+the naive pass groups edges by ring offset ``(dst - src) % size`` — each
+group is a partial permutation, and for the circulant topologies (Exp2,
+ring, fully-connected) a *full* permutation riding ICI, with Exp-2 needing
+only ``log2(N)`` rounds. An irregular edge set can scatter over O(N)
+distinct offsets, so a second pass edge-colors the source x destination
+bipartite graph (König/Kempe chains) into the provably minimal
+``max(max_in_degree, max_out_degree)`` rounds; an alpha-beta cost model
+takes the coloring only on a strict round-count win, keeping the circulant
+fast path byte-identical. The decision and predicted cost are recorded on
+the plan (``CommPlan.compile_info``).
 
 Weighting is receiver-side: after round ``r`` each rank multiplies what it
 received by ``recv_weights[r][self]``. Because every rank receives from at
@@ -37,6 +41,9 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 import networkx as nx
+
+from bluefog_tpu.collective import compiler
+from bluefog_tpu.collective.compiler import CompiledEdges
 
 __all__ = [
     "CommRound",
@@ -85,6 +92,13 @@ class CommPlan:
     size: int
     self_weights: Tuple[float, ...]
     rounds: Tuple[CommRound, ...]
+    # Compiler decision record (decomposition, naive round count, König
+    # bound, predicted alpha-beta cost) — observability metadata, excluded
+    # from equality/hash so structurally identical plans stay one compiled
+    # program regardless of how their lowering was annotated.
+    compile_info: Optional[CompiledEdges] = dataclasses.field(
+        default=None, compare=False
+    )
 
     @property
     def perms(self) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
@@ -176,23 +190,21 @@ class SchedulePlan:
 
 
 def perms_from_edges(
-    edges: Iterable[Tuple[int, int]], size: int
+    edges: Iterable[Tuple[int, int]], size: int, method: str = "auto"
 ) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
-    """Group directed edges by ring offset ``(dst - src) % size`` into
-    partial permutations — the single source of truth for the structure
-    lowering (used by plans here and by the window subsystem)."""
-    by_offset: Dict[int, List[Tuple[int, int]]] = {}
-    for i, j in edges:
-        if i == j:
-            continue
-        by_offset.setdefault((j - i) % size, []).append((int(i), int(j)))
-    return tuple(
-        tuple(sorted(by_offset[offset])) for offset in sorted(by_offset)
-    )
+    """Pack directed edges into partial-permutation rounds — the single
+    source of truth for the structure lowering (used by plans here and by
+    the window subsystem). Delegates to the pass pipeline in
+    :mod:`bluefog_tpu.collective.compiler`: offset grouping, minimal
+    edge-coloring, and the cost-modeled choice between them (``method``
+    forces one pass for A/B measurement)."""
+    return compiler.compile_edges(edges, size, method=method).perms
 
 
 def plan_from_matrix(
-    w: np.ndarray, edges: Optional[Iterable[Tuple[int, int]]] = None
+    w: np.ndarray,
+    edges: Optional[Iterable[Tuple[int, int]]] = None,
+    method: str = "auto",
 ) -> CommPlan:
     """Build a plan from a combine matrix ``W`` (``W[i, j]`` = weight rank
     ``j`` applies to rank ``i``'s value; diagonal = self weights).
@@ -200,7 +212,10 @@ def plan_from_matrix(
     Edges default to the off-diagonal nonzeros; pass ``edges`` explicitly to
     keep declared-but-zero-weighted links in the communication pattern (a
     zero src weight must not shrink neighbor_allgather membership). Edges
-    are grouped by ring offset ``(j - i) % size`` into partial permutations.
+    are packed into rounds by the comm-plan compiler (offset grouping vs
+    minimal edge coloring, cost-modeled; see
+    :mod:`bluefog_tpu.collective.compiler`), and the decision is recorded
+    on ``CommPlan.compile_info``.
     """
     w = np.asarray(w, dtype=np.float64)
     size = w.shape[0]
@@ -208,8 +223,9 @@ def plan_from_matrix(
 
     if edges is None:
         edges = zip(*np.nonzero(w))
+    compiled = compiler.compile_edges(edges, size, method=method)
     rounds = []
-    for perm in perms_from_edges(edges, size):
+    for perm in compiled.perms:
         weights = [0.0] * size
         for s, d in perm:
             weights[d] = float(w[s, d])
@@ -219,10 +235,13 @@ def plan_from_matrix(
         size=size,
         self_weights=tuple(float(w[i, i]) for i in range(size)),
         rounds=tuple(rounds),
+        compile_info=compiled,
     )
 
 
-def plan_from_topology(topo: nx.DiGraph, weighted: bool = True) -> CommPlan:
+def plan_from_topology(
+    topo: nx.DiGraph, weighted: bool = True, method: str = "auto"
+) -> CommPlan:
     """Lower a static ``networkx.DiGraph`` topology to a plan.
 
     ``weighted=True`` uses the graph's edge weights (the generators produce
@@ -244,7 +263,7 @@ def plan_from_topology(topo: nx.DiGraph, weighted: bool = True) -> CommPlan:
             for i in in_lists[j]:
                 u[i, j] = uniform
         w = u
-    return plan_from_matrix(w, edges=edges)
+    return plan_from_matrix(w, edges=edges, method=method)
 
 
 def _normalize_per_rank(
@@ -305,6 +324,7 @@ def plan_from_weights(
     src_weights: Union[Dict[int, Dict[int, float]], Sequence[Dict[int, float]]],
     dst_weights: Union[Dict[int, Dict[int, float]], Sequence, None] = None,
     enable_topo_check: bool = True,
+    method: str = "auto",
 ) -> CommPlan:
     """Build a plan from explicit per-rank weights (the dynamic-graph path).
 
@@ -341,7 +361,7 @@ def plan_from_weights(
             scale = dsts[i].get(j, 1.0) if dsts is not None else 1.0
             w[i, j] = wt * scale
             edges.append((i, j))
-    return plan_from_matrix(w, edges=edges)
+    return plan_from_matrix(w, edges=edges, method=method)
 
 
 def schedule_from_dynamic(
@@ -350,6 +370,7 @@ def schedule_from_dynamic(
     period: Optional[int] = None,
     self_weight: Optional[float] = None,
     uniform: bool = True,
+    method: str = "auto",
 ) -> SchedulePlan:
     """Lower a reference-style dynamic generator to a periodic schedule.
 
@@ -404,7 +425,7 @@ def schedule_from_dynamic(
                     w[i, i] = sw
                     for j in send:
                         w[i, j] = (1.0 - sw) / len(send)
-        plans.append(plan_from_matrix(w, edges=edges))
+        plans.append(plan_from_matrix(w, edges=edges, method=method))
     return SchedulePlan(plans=tuple(plans))
 
 

@@ -1,0 +1,84 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""JAX version-compatibility shims.
+
+The codebase targets the current JAX API surface (``jax.shard_map``,
+``jax.typeof``); CI containers can lag several minor versions behind.
+Importing this module (done unconditionally from the package root, so
+every entry point gets it) installs the missing aliases on older
+installs:
+
+- ``jax.shard_map``: promoted from ``jax.experimental.shard_map`` in
+  newer releases; same call signature for the keyword form used
+  throughout (``mesh=``, ``in_specs=``, ``out_specs=``).
+- ``jax.typeof``: newer spelling of "aval of"; the fallback returns
+  ``jax.core.get_aval`` output, which lacks the ``vma`` attribute — the
+  single caller (:mod:`bluefog_tpu.ops.flash`) reads it with a
+  ``getattr`` default for exactly this reason.
+- :func:`shape_dtype_struct`: ``jax.ShapeDtypeStruct`` grew a ``vma``
+  keyword alongside shard_map's varying-manual-axes checks; older
+  versions reject it, and dropping it there is correct (no vma checking
+  exists to inform).
+
+Shims are additive aliases only — on a current JAX this module is a
+no-op.
+"""
+
+import jax
+
+__all__ = ["shape_dtype_struct", "IS_MODERN_JAX", "PLATFORM_DEPENDENT_PRUNES"]
+
+# Recorded BEFORE any alias installs below: whether this jax natively has
+# the current API surface the codebase targets.
+IS_MODERN_JAX = hasattr(jax, "shard_map")
+
+# Old jax traces AND lowers every branch of ``lax.platform_dependent``
+# (no dead-branch pruning at lowering), so a Mosaic kernel in the TPU
+# branch fails CPU lowering; callers must fall back to a host-side
+# platform choice there.
+PLATFORM_DEPENDENT_PRUNES = IS_MODERN_JAX
+
+if not hasattr(jax, "shard_map"):
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def _shard_map_compat(f, **kwargs):
+        # Old shard_map's replication checker has no rule for pallas_call
+        # (the flash kernels run inside shard_map bodies); newer JAX
+        # replaced it with vma-based checking that handles them. Default
+        # the check off — it is a static validity check, not part of the
+        # computed program.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, **kwargs)
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(jax.lax, "pcast"):
+    # vma (varying-manual-axes) casts only exist alongside the new
+    # shard_map type system; without it every value is already implicitly
+    # varying, so the cast is the identity.
+    def _pcast(x, axis_name=None, *, to=None):
+        del axis_name, to
+        return x
+
+    jax.lax.pcast = _pcast
+
+if not hasattr(jax, "typeof"):
+    from jax import core as _core
+
+    def _typeof(x):
+        return _core.get_aval(x)
+
+    jax.typeof = _typeof
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` with the ``vma`` keyword dropped on JAX
+    versions that predate it."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma JAX: no manual-axes checking to inform
+        return jax.ShapeDtypeStruct(shape, dtype)

@@ -7,10 +7,13 @@ the hand-tiled TPU kernels for the same math — flash-attention online
 softmax with one pass over K/V tiles, f32 accumulators in VMEM, causal
 tiles skipped entirely (not just masked) so the causal kernel does half
 the work. Layout follows the MXU/VPU tiling rules: Q/K/V tiles are
-``[block, head_dim]`` with ``head_dim`` and blocks multiples of 128 lanes
-/ 8 sublanes (``pallas_guide``: tiling constraints). Ragged sequence
-lengths and narrow heads tile via zero padding + in-kernel masking (an
-O(T·d) copy), never an O(T²) dense fallback.
+``[block, head_dim]`` with sequence blocks multiples of 128 lanes / 8
+sublanes (``pallas_guide``: tiling constraints). Ragged sequence lengths
+tile via zero padding + in-kernel masking along the SEQUENCE axis only
+(an O(T·d) copy), never an O(T²) dense fallback; ``head_dim`` is
+deliberately never padded — the kernel's block dim equals the array dim
+(Mosaic handles lane packing for narrow heads, and an explicit pad to
+128 would double the matmul FLOPs at d=64).
 
 Training-ready: a ``jax.custom_vjp`` pairs the forward kernel (which also
 emits the per-row logsumexp) with FlashAttention-2-style backward kernels
@@ -32,6 +35,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from bluefog_tpu import compat
 
 try:  # pltpu is importable on CPU builds too; guard anyway
     from jax.experimental.pallas import tpu as pltpu
@@ -167,8 +172,8 @@ def _fwd_call(qf, kf, vf, causal, scale, block_q, block_k, kv_len,
             block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t_pad, d_pad), out_dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, t_pad, _SUB), jnp.float32, vma=vma),
+            compat.shape_dtype_struct((bh, t_pad, d_pad), out_dtype, vma=vma),
+            compat.shape_dtype_struct((bh, t_pad, _SUB), jnp.float32, vma=vma),
         ),
         grid=grid,
         in_specs=[
@@ -341,8 +346,8 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
             block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh_kv, t_pad, d_pad), kf.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh_kv, t_pad, d_pad), vf.dtype, vma=vma),
+            compat.shape_dtype_struct((bh_kv, t_pad, d_pad), kf.dtype, vma=vma),
+            compat.shape_dtype_struct((bh_kv, t_pad, d_pad), vf.dtype, vma=vma),
         ),
         grid=(bh_kv, t_pad // block_k, group * n_q),
         in_specs=[q_gqa, k_spec, k_spec, q_gqa, r_gqa, r_gqa, r_gqa],
@@ -366,7 +371,7 @@ def _bwd_call(qf, kf, vf, of, lse, do, causal, scale, block_q, block_k,
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, kv_len=kv_len, t_pad=t_pad,
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qf.dtype,
+        out_shape=compat.shape_dtype_struct((bh, t_pad, d_pad), qf.dtype,
                                        vma=vma),
         grid=(bh, t_pad // block_q, t_pad // block_k),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2,
@@ -521,6 +526,12 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     if interpret:
         return _flash_with_lse(q, k, v, causal, float(scale), block_q,
                                block_k, True)
+    if not compat.PLATFORM_DEPENDENT_PRUNES:
+        # old jax lowers dead platform branches too (see flash_attention)
+        if jax.default_backend() == "tpu":
+            return _flash_with_lse(q, k, v, causal, float(scale), block_q,
+                                   block_k, False)
+        return _dense_with_lse(q, k, v, causal, scale)
     return jax.lax.platform_dependent(
         q, k, v,
         tpu=lambda q, k, v: _flash_with_lse(
@@ -637,7 +648,17 @@ def flash_attention(q, k, v, causal: bool = False,
     # actually LOWERS for, not the default backend: a CPU mesh inside a
     # TPU-ambient process (the dev/test pattern) would otherwise try to
     # lower the Mosaic kernel for CPU. platform_dependent resolves at
-    # lowering time, per backend.
+    # lowering time, per backend — but only on a jax that prunes dead
+    # branches there; older versions lower every branch, so the choice
+    # degrades to the host-side default backend.
+    if not compat.PLATFORM_DEPENDENT_PRUNES:
+        if jax.default_backend() == "tpu":
+            return _flash(
+                q, k, v, causal, float(scale), block_q, block_k, False
+            )
+        return reference_attention(
+            q, k, v, causal=causal, scale=scale
+        ).astype(q.dtype)
     return jax.lax.platform_dependent(
         q, k, v,
         tpu=lambda q, k, v: _flash(

@@ -40,12 +40,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bluefog_tpu.collective import inner
 from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 
+# Alpha-beta wire-model constants shared with the comm-plan compiler's
+# cost model (bluefog_tpu.collective.compiler): per-round fixed latency
+# plus payload/bandwidth over an ICI link.
+from bluefog_tpu.collective.compiler import (  # noqa: F401  (re-export)
+    ROUND_ALPHA_S,
+    ICI_LINK_BYTES_PER_S,
+    plan_cost_s,
+)
+
 __all__ = [
     "hlo_collective_stats",
     "gossip_comm_stats",
+    "plan_comm_summary",
     "ring_allreduce_cost",
     "one_peer_gossip_cost",
     "weak_scaling_times",
+    "ROUND_ALPHA_S",
+    "ICI_LINK_BYTES_PER_S",
+    "plan_cost_s",
 ]
 
 _DTYPE_BYTES = {
@@ -137,11 +150,30 @@ def _mesh(n: int) -> Mesh:
     return Mesh(np.array(devices[:n]), ("workers",))
 
 
+def plan_comm_summary(plan: CommPlan, payload_bytes: int) -> Dict[str, object]:
+    """Per-plan round/byte accounting: the compiler's decomposition
+    decision, naive-vs-chosen round counts, the König lower bound, and the
+    alpha-beta predicted step cost for a given gossip payload."""
+    info = plan.compile_info
+    rounds = len(plan.rounds)
+    naive_rounds = info.offset_rounds if info else rounds
+    return {
+        "rounds": rounds,
+        "decomposition": info.method if info else "offset",
+        "naive_rounds": naive_rounds,
+        "lower_bound": info.lower_bound if info else rounds,
+        "wire_bytes_per_round": payload_bytes,
+        "predicted_cost_us": plan_cost_s(rounds, payload_bytes) * 1e6,
+        "naive_cost_us": plan_cost_s(naive_rounds, payload_bytes) * 1e6,
+    }
+
+
 def gossip_comm_stats(
     plan: CommPlan,
     payload_elems: int,
     dtype=jnp.float32,
     mode: str = "neighbor_allreduce",
+    include_plan: bool = False,
 ) -> Dict[str, Dict[str, int]]:
     """Compile one combine step over ``plan`` and account its collectives.
 
@@ -149,7 +181,10 @@ def gossip_comm_stats(
     ``"allreduce"`` (``lax.psum``, the Horovod-style baseline the reference
     compares against). The compiled program is the *exact* per-iteration
     communication — this is the TPU-native replacement for wire-level
-    NCCL/MPI tracing.
+    NCCL/MPI tracing. ``include_plan=True`` adds a ``"plan"`` entry with
+    the compiler's per-plan round accounting (:func:`plan_comm_summary`);
+    it is opt-in because the other entries are homogeneous
+    ``{count, bytes}`` dicts that callers aggregate over.
     """
     n = plan.size
     mesh = _mesh(n)
@@ -170,7 +205,12 @@ def gossip_comm_stats(
     compiled = fn.lower(
         jax.device_put(x, NamedSharding(mesh, P("workers")))
     ).compile()
-    return hlo_collective_stats(compiled.as_text())
+    stats = hlo_collective_stats(compiled.as_text())
+    if include_plan:
+        stats["plan"] = plan_comm_summary(
+            plan, payload_elems * np.dtype(dtype).itemsize
+        )
+    return stats
 
 
 def ring_allreduce_cost(n: int, payload_bytes: int) -> Dict[str, float]:

@@ -16,7 +16,8 @@ __all__ = ["settle", "timed_differenced"]
 _TAKE = None
 
 
-def timed_differenced(step, steps: int, windows: int):
+def timed_differenced(step, steps: int, windows: int,
+                      with_degenerate: bool = False):
     """Differenced-window timing: per window, time ``steps`` calls +
     settle and ``2*steps`` calls + settle; the difference is ``steps``
     calls of pure compute with the settle RTT (~100 +-50 ms through the
@@ -24,18 +25,29 @@ def timed_differenced(step, steps: int, windows: int):
     used through round 4 cancelled it only in expectation and swung
     results several % either way.
 
+    A window whose difference comes out ``<= 0`` (an ambient stall
+    landed inside the first half) is DEGENERATE: its clamped value would
+    publish as a fake ~0 time (the r05 evidence artifact's
+    ``dense_fwdbwd_ms: 0.0``). Each degenerate window gets one retry;
+    windows still degenerate after that are excluded from the result as
+    long as at least one clean window exists. Only when EVERY window is
+    degenerate do the clamped values come back, flagged.
+
     ``step()`` advances whatever state it closes over and returns the
     settle target (keep it SCALAR — settling a large tensor pays the
-    tunnel transfer). Returns the per-call times of all windows, sorted
-    ascending (``[0]`` is the best window; the spread is the honest
-    noise disclosure)."""
+    tunnel transfer). Returns the per-call times of the clean windows,
+    sorted ascending (``[0]`` is the best window; the spread is the
+    honest noise disclosure). With ``with_degenerate=True`` returns
+    ``(times, degenerate)`` where ``degenerate`` is True only in the
+    all-windows-clamped case."""
     import time
 
     out = step()
     settle(out)
     settle(out)  # warm the readback path's own compile
-    dts = []
-    for _ in range(windows):
+
+    def one_window():
+        nonlocal out
         t0 = time.perf_counter()
         for _ in range(steps):
             out = step()
@@ -45,8 +57,19 @@ def timed_differenced(step, steps: int, windows: int):
             out = step()
         settle(out)
         t2 = time.perf_counter()
-        dts.append(max((t2 - t1) - (t1 - t0), 1e-9) / steps)
-    return sorted(dts)
+        return (t2 - t1) - (t1 - t0)
+
+    diffs = []
+    for _ in range(windows):
+        diff = one_window()
+        if diff <= 0:
+            diff = one_window()  # one retry: stalls are transient
+        diffs.append(diff)
+    clean = sorted(d / steps for d in diffs if d > 0)
+    if clean:
+        return (clean, False) if with_degenerate else clean
+    clamped = sorted(max(d, 1e-9) / steps for d in diffs)
+    return (clamped, True) if with_degenerate else clamped
 
 
 def settle(x) -> float:

@@ -14,6 +14,7 @@ from bluefog_tpu.topology.graphs import (
     StarGraph,
     RingGraph,
     FullyConnectedGraph,
+    RandomRegularDigraph,
     IsTopologyEquivalent,
     IsRegularGraph,
     GetRecvWeights,
@@ -47,6 +48,7 @@ __all__ = [
     "StarGraph",
     "RingGraph",
     "FullyConnectedGraph",
+    "RandomRegularDigraph",
     "PowerTwoRingGraph",
     "IsTopologyEquivalent",
     "IsRegularGraph",

@@ -418,9 +418,15 @@ def _lowered_exchange(ctx, win, w_edges):
     pattern for every step, and the O(size^2) lowering must not sit in that
     hot path. Weight *values* are deliberately not in the key; the
     structure is fingerprinted as a packed bitmask (the per-call edge-tuple
-    materialization was ~12 ms at size=1024)."""
+    materialization was ~12 ms at size=1024). Rounds come from the
+    comm-plan compiler (minimum-round packing for irregular put/get
+    patterns; the receiver-side slot table only assumes each destination
+    hears from <= 1 source per round, which every decomposition
+    guarantees)."""
     mask = w_edges != 0
-    key = ("win_lowering", win.in_neighbors, np.packbits(mask).tobytes())
+    method = col_ops._plan_method()
+    key = ("win_lowering", win.in_neighbors, np.packbits(mask).tobytes(),
+           method)
     cached = ctx.op_cache.get(key)
     if cached is None:
         from bluefog_tpu.collective.plan import perms_from_edges
@@ -428,7 +434,7 @@ def _lowered_exchange(ctx, win, w_edges):
         edges = tuple(
             (int(i), int(j)) for i, j in zip(*np.nonzero(mask))
         )
-        perms = perms_from_edges(edges, w_edges.shape[0])
+        perms = perms_from_edges(edges, w_edges.shape[0], method=method)
         cached = (perms, _slot_table(win, perms))
         ctx.op_cache[key] = cached
     return cached

@@ -3,7 +3,7 @@
 
 Reference analogue: ``scripts/pytorch_opt_linear_speedup_test.py`` —
 performance claims live in runnable assertions, not prose. The scaling
-family runs anywhere (virtual CPU mesh); the gossip-overhead <5 %
+family runs anywhere (virtual CPU mesh); the gossip-overhead <10 %
 assertion needs the real chip, so it runs when the ambient environment
 offers one (the driver/judge host) and skips on plain CPU CI.
 """

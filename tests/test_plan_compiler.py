@@ -1,0 +1,369 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Comm-plan compiler coverage: minimal round packing, preserved fast
+path, and semantic equivalence.
+
+Three layers of proof, mirroring the repo's HLO-verification style
+(test_fusion.py):
+
+- *structural*: the edge-coloring pass hits the König bound
+  ``max(max_in_degree, max_out_degree)`` on fuzzed digraphs, every round
+  is a partial permutation, and circulant topologies keep their
+  byte-identical offset-grouped lowering;
+- *compiled*: the optimized HLO for star / mesh2d / sparse random
+  digraphs contains exactly the bound's number of ``collective-permute``
+  instructions (the naive lowering emits up to N-1);
+- *semantic*: ``weighted_combine`` over the optimized plan is EXACTLY the
+  naive plan's result. Round re-packing permutes the order of per-receiver
+  additions, so genuine float inputs could differ in the last ulp without
+  meaning anything; the equality tests therefore use dyadic-rational
+  weights and integer-valued inputs, for which every product and partial
+  sum is exactly representable — bitwise equality then PROVES semantic
+  equivalence rather than sampling it.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import networkx as nx
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu.topology as topo
+from bluefog_tpu import scaling
+from bluefog_tpu.collective import compiler, inner, plan as planlib
+
+SIZE = 8
+AXIS = "workers"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_spmd(fn, *arrays, out_specs=P(AXIS)):
+    m = jax.make_mesh((SIZE,), (AXIS,))
+    wrapped = jax.jit(
+        jax.shard_map(
+            fn, mesh=m, in_specs=tuple(P(AXIS) for _ in arrays),
+            out_specs=out_specs,
+        )
+    )
+    return wrapped(*arrays)
+
+
+def random_edges(rng, size):
+    all_edges = [
+        (i, j) for i in range(size) for j in range(size) if i != j
+    ]
+    k = rng.randint(0, len(all_edges) + 1)
+    idx = rng.choice(len(all_edges), size=k, replace=False)
+    return [all_edges[i] for i in idx]
+
+
+# -- structural --------------------------------------------------------------
+
+
+def test_coloring_meets_koenig_bound_fuzzed():
+    rng = np.random.RandomState(0)
+    for _ in range(100):
+        size = rng.randint(2, 17)
+        edges = random_edges(rng, size)
+        perms = compiler.coloring_perms(edges, size)
+        assert len(perms) == compiler.min_rounds(edges, size)
+        # partition + partial-permutation invariants (also asserted
+        # inside the pass; re-checked here from the public result)
+        flat = [e for p in perms for e in p]
+        assert sorted(flat) == sorted(set(map(tuple, edges)))
+        for p in perms:
+            assert len({s for s, _ in p}) == len(p)
+            assert len({d for _, d in p}) == len(p)
+
+
+def test_auto_never_worse_than_offset_and_reaches_bound():
+    rng = np.random.RandomState(1)
+    for _ in range(50):
+        size = rng.randint(2, 17)
+        edges = random_edges(rng, size)
+        res = compiler.compile_edges(edges, size)
+        assert res.lower_bound <= res.rounds <= res.offset_rounds
+        # auto must always land ON the bound: either offsets already
+        # meet it or the coloring is taken
+        assert res.rounds == res.lower_bound or not edges
+
+
+def test_circulant_topologies_keep_offset_fast_path():
+    for g, rounds in (
+        (topo.ExponentialTwoGraph(SIZE), 3),
+        (topo.RingGraph(SIZE), 2),  # offsets {+1, -1}; the self loop is no round
+        (topo.FullyConnectedGraph(SIZE), 7),
+    ):
+        plan = planlib.plan_from_topology(g, weighted=True)
+        assert plan.compile_info.method == "offset"
+        assert len(plan.rounds) == rounds
+        # circulant rounds are FULL permutations riding ICI
+        assert all(len(r.perm) == SIZE for r in plan.rounds)
+        naive = planlib.plan_from_topology(g, weighted=True, method="offset")
+        assert plan.perms == naive.perms
+
+
+def test_compile_cache_dedupes_repeated_lowerings():
+    edges = [(0, 1), (2, 1), (3, 1), (1, 5), (4, 5)]
+    a = compiler.compile_edges(edges, SIZE)
+    b = compiler.compile_edges(list(reversed(edges)), SIZE)
+    assert a is b  # canonical edge set -> one host-side compile
+
+
+def test_forced_methods_and_cost_model():
+    edges = [(0, 1), (2, 1), (3, 1), (1, 5), (4, 5), (6, 2), (7, 3)]
+    auto = compiler.compile_edges(edges, SIZE)
+    off = compiler.compile_edges(edges, SIZE, method="offset")
+    col = compiler.compile_edges(edges, SIZE, method="coloring")
+    assert off.method == "offset" and off.rounds == off.offset_rounds
+    assert col.rounds == col.lower_bound
+    assert auto.method == "coloring" and auto.perms == col.perms
+    # cost model: strictly fewer rounds -> strictly cheaper plan
+    assert auto.predicted_cost_s < auto.offset_cost_s
+    payload = 1024
+    assert scaling.plan_cost_s(2, payload) == pytest.approx(
+        2 * (scaling.ROUND_ALPHA_S + payload / scaling.ICI_LINK_BYTES_PER_S)
+    )
+
+
+@pytest.mark.parametrize("degree", [2, 6, 7])
+def test_random_regular_digraph_properties(degree):
+    """Sparse degrees come from rejection sampling; dense degrees (the
+    rejection-hostile regime, up to the complete digraph) from the
+    coloring-based completion — both must produce exact regularity."""
+    g = topo.RandomRegularDigraph(SIZE, degree, seed=3)
+    w = nx.to_numpy_array(g)
+    off_diag = (w != 0) & ~np.eye(SIZE, dtype=bool)
+    assert (off_diag.sum(1) == degree).all()
+    assert (off_diag.sum(0) == degree).all()
+    np.testing.assert_allclose(w.sum(0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(w.sum(1), 1.0, atol=1e-12)
+
+
+# -- compiled (HLO round-count regression) -----------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,make",
+    [
+        ("star", lambda: topo.StarGraph(SIZE)),
+        ("mesh2d", lambda: topo.MeshGrid2DGraph(SIZE)),
+        ("random_d2", lambda: topo.RandomRegularDigraph(SIZE, 2, seed=3)),
+    ],
+)
+def test_optimized_hlo_emits_bound_many_permutes(name, make):
+    """The compiled program for an optimized plan contains exactly the
+    König bound's number of collective-permutes."""
+    plan = planlib.plan_from_topology(make(), weighted=True)
+    info = plan.compile_info
+    stats = scaling.gossip_comm_stats(plan, 256)
+    cp = stats.get("collective-permute", {"count": 0})
+    assert cp["count"] == info.lower_bound, (name, stats, info)
+    assert cp["count"] <= info.offset_rounds
+
+
+def test_random_digraph_hlo_beats_naive_lowering():
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    optimized = planlib.plan_from_topology(g, weighted=True)
+    naive = planlib.plan_from_topology(g, weighted=True, method="offset")
+    assert optimized.compile_info.method == "coloring"
+    assert len(optimized.rounds) == 2 < len(naive.rounds)
+    n_stats = scaling.gossip_comm_stats(naive, 256)
+    o_stats = scaling.gossip_comm_stats(optimized, 256)
+    assert o_stats["collective-permute"]["count"] == 2
+    assert (
+        n_stats["collective-permute"]["count"] == len(naive.rounds) > 2
+    )
+
+
+def test_gossip_comm_stats_plan_summary():
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    plan = planlib.plan_from_topology(g, weighted=True)
+    stats = scaling.gossip_comm_stats(plan, 256, include_plan=True)
+    summary = stats["plan"]
+    assert summary["rounds"] == 2
+    assert summary["decomposition"] == "coloring"
+    assert summary["naive_rounds"] > 2 and summary["lower_bound"] == 2
+    assert summary["predicted_cost_us"] < summary["naive_cost_us"]
+    # default shape untouched: every non-plan entry is {count, bytes}
+    plain = scaling.gossip_comm_stats(plan, 256)
+    assert "plan" not in plain
+
+
+# -- semantic equivalence ----------------------------------------------------
+
+
+def dyadic_matrix(rng, size):
+    """Random combine matrix with dyadic-rational entries (k/64) so the
+    f32 combine arithmetic is exact regardless of summation order."""
+    w = rng.randint(-64, 65, size=(size, size)).astype(np.float64) / 64.0
+    mask = rng.rand(size, size) < 0.5
+    np.fill_diagonal(mask, True)
+    return np.where(mask, w, 0.0)
+
+
+def combine(plan, x):
+    got = run_spmd(
+        functools.partial(
+            inner.weighted_combine, plan=plan, axis_name=AXIS
+        ),
+        x,
+    )
+    return np.asarray(got)
+
+
+def test_optimized_combine_bitwise_equals_naive():
+    """Exact (same dtype path) equality on randomized weight matrices,
+    including zero-weighted declared edges."""
+    rng = np.random.RandomState(7)
+    for trial in range(10):
+        w = dyadic_matrix(rng, SIZE)
+        # declare EVERY off-diagonal position an edge, including the
+        # zero-weighted ones — pattern membership must not depend on the
+        # weight value
+        edges = [
+            (i, j) for i in range(SIZE) for j in range(SIZE) if i != j
+        ]
+        naive = planlib.plan_from_matrix(w, edges=edges, method="offset")
+        opt = planlib.plan_from_matrix(w, edges=edges, method="coloring")
+        np.testing.assert_array_equal(
+            naive.weight_matrix(), opt.weight_matrix()
+        )
+        x = rng.randint(-8, 9, size=(SIZE, 16)).astype(np.float32)
+        got_naive, got_opt = combine(naive, x), combine(opt, x)
+        assert got_naive.dtype == got_opt.dtype == np.float32
+        np.testing.assert_array_equal(got_naive, got_opt), trial
+
+
+def test_optimized_combine_sparse_auto_wins_and_matches():
+    rng = np.random.RandomState(11)
+    for trial in range(10):
+        g = topo.RandomRegularDigraph(SIZE, 2, seed=100 + trial)
+        adj = (nx.to_numpy_array(g) != 0) & ~np.eye(SIZE, dtype=bool)
+        w = np.where(adj, dyadic_matrix(rng, SIZE), 0.0)
+        np.fill_diagonal(w, rng.randint(-64, 65, SIZE) / 64.0)
+        edges = [tuple(e) for e in zip(*np.nonzero(adj))]
+        naive = planlib.plan_from_matrix(w, edges=edges, method="offset")
+        auto = planlib.plan_from_matrix(w, edges=edges)
+        assert len(auto.rounds) <= len(naive.rounds)
+        x = rng.randint(-8, 9, size=(SIZE, 4)).astype(np.float32)
+        np.testing.assert_array_equal(combine(naive, x), combine(auto, x))
+
+
+def test_dynamic_schedule_offset_vs_coloring_identical():
+    """Dynamic schedules lower per-step through the same compiler; the
+    mass-conserving one-peer schedule has purely dyadic weights (0.5 /
+    1.0), so offset and coloring plans must agree bitwise step by step."""
+    g = topo.ExponentialTwoGraph(SIZE)
+    mk = lambda method: planlib.schedule_from_dynamic(
+        SIZE,
+        lambda r: topo.GetDynamicOnePeerSendRecvRanks(g, r),
+        self_weight=0.5,
+        uniform=False,
+        method=method,
+    )
+    s_off, s_col = mk("offset"), mk("coloring")
+    assert s_off.period == s_col.period
+    rng = np.random.RandomState(13)
+    x = rng.randint(-8, 9, size=(SIZE, 4)).astype(np.float32)
+    for p_off, p_col in zip(s_off.plans, s_col.plans):
+        np.testing.assert_array_equal(
+            p_off.weight_matrix(), p_col.weight_matrix()
+        )
+        np.testing.assert_array_equal(combine(p_off, x), combine(p_col, x))
+
+
+def test_dynamic_schedule_uniform_close():
+    """Uniform one-peer weights (1/(deg+1)) are not dyadic, so the
+    guarantee is weight-matrix identity plus tight numeric agreement."""
+    g = topo.ExponentialTwoGraph(SIZE)
+    mk = lambda method: planlib.schedule_from_dynamic(
+        SIZE,
+        lambda r: topo.GetDynamicOnePeerSendRecvRanks(g, r),
+        method=method,
+    )
+    s_off, s_col = mk("offset"), mk("coloring")
+    x = np.random.RandomState(17).randn(SIZE, 4).astype(np.float32)
+    for p_off, p_col in zip(s_off.plans, s_col.plans):
+        np.testing.assert_array_equal(
+            p_off.weight_matrix(), p_col.weight_matrix()
+        )
+        np.testing.assert_allclose(
+            combine(p_off, x), combine(p_col, x), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_windows_on_irregular_topology_use_packed_rounds():
+    """The window subsystem lowers its put/get patterns through the same
+    compiler; semantics (buffer contents) must be decomposition-blind."""
+    import bluefog_tpu as bf
+
+    bf.init(devices=jax.devices("cpu")[:SIZE])
+    try:
+        g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+        bf.set_topology(g)
+        x = bf.worker_values(lambda r: np.full((3,), float(r), np.float32))
+        assert bf.win_create(x, "plan_test")
+        bf.win_put(name="plan_test")
+        adj = (nx.to_numpy_array(g) != 0) & ~np.eye(SIZE, dtype=bool)
+        expected = np.zeros((SIZE, 3))
+        for j in range(SIZE):
+            srcs = sorted(np.nonzero(adj[:, j])[0])
+            deg = len(srcs)
+            # default win_update: uniform 1/(deg+1) over self + buffers,
+            # each buffer holding dst_weight(=1.0) * src value
+            expected[j] = (j + sum(srcs)) / (deg + 1.0)
+        got = np.asarray(bf.win_update(name="plan_test"))
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+    finally:
+        bf.win_free()
+        bf.shutdown()
+
+
+# -- acceptance: 16-rank sparse digraph via BENCH_MODE=plan ------------------
+
+
+def test_bench_plan_mode_16_rank_bound():
+    """End-to-end acceptance: `BENCH_MODE=plan` on a 16-device virtual
+    mesh reports star / mesh2d / random lines; the degree-3 random
+    digraph lowers to exactly 3 rounds, verified from compiled HLO."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_MODE"] = "plan"
+    env["BENCH_STEPS"] = "2"
+    env["BENCH_WINDOWS"] = "1"
+    env["BENCH_PLAN_PAYLOAD_ELEMS"] = "1024"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = {
+        l["topology"]: l
+        for l in map(json.loads, out.stdout.splitlines())
+        if l.get("metric") == "plan_compiler"
+    }
+    assert {"star", "mesh2d", "random_d3"} <= set(lines)
+    for l in lines.values():
+        assert l["optimized_rounds"] <= l["naive_rounds"], l
+        assert l["hlo_collective_permutes"] == l["optimized_rounds"], l
+        assert l["optimized_ms_per_step"] > 0, l
+    rand = lines["random_d3"]
+    assert rand["n_workers"] == 16
+    assert rand["optimized_rounds"] == 3 == rand["lower_bound"], rand
+    assert rand["naive_rounds"] > 3, rand
+    assert rand["decomposition"] == "coloring", rand
+    # circulant fast path: exp2 keeps its offset rounds
+    assert lines["exp2"]["decomposition"] == "offset"
+    assert lines["exp2"]["optimized_rounds"] == lines["exp2"]["naive_rounds"]
