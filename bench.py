@@ -31,6 +31,11 @@ Individual families via ``BENCH_MODE``:
   compiled HLO, plus measured gossip-step times for irregular
   topologies (star, mesh2d, sparse random digraph). See
   ``docs/plan_compiler.md``.
+- ``overlap``: exposed-communication comparison for the fused train
+  step (two-program baseline vs fused vs fused+buckets vs delayed),
+  per-bucket schedule timeline, and the static HLO overlap scan
+  (``tools/hlo_overlap_scan.py``). See docs/performance.md
+  "Overlapping communication with compute".
 
 Timing windows that come out degenerate (a clamped ``diff <= 0`` in
 ``timed_differenced`` — an ambient stall ate the differenced half) are
@@ -613,6 +618,304 @@ def run_gossip_overhead() -> int:
     return 0
 
 
+def run_overlap() -> int:
+    """Exposed-communication comparison for the overlap layer
+    (``opt.make_train_step``): two-program baseline vs fused vs
+    fused+buckets vs delayed, plus the static HLO overlap scan.
+
+    Each variant trains the same MLP regression step over an Exp2 gossip
+    topology; ``exposed_comm_ms`` is the variant's step time minus the
+    communication-free fused step (the compute floor), so it measures
+    exactly the communication left on the critical path. The HLO scan
+    (tools/hlo_overlap_scan.py) verifies the overlap claim statically:
+    on TPU it counts async ``collective-permute-start``/``-done`` pairs
+    with compute scheduled between them; on CPU (whose backend keeps
+    collectives synchronous at the HLO level) it proves overlap
+    *capability* by def-use independence instead. Runs on the ambient
+    platform when it exposes >1 device (a real slice); otherwise on a
+    virtual CPU mesh.
+    """
+    native = os.environ.get("BENCH_SCALING_PLATFORM", "")
+    ambient = os.environ.get("JAX_PLATFORMS", "")
+    use_native = native == "native" or (
+        native == "" and ambient not in ("", "cpu")
+    )
+    if not use_native:
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_OVERLAP_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu.collective import inner as col_inner
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.hlo_overlap_scan import scan_overlap
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform not in ("cpu",)
+    n = min(len(devices), int(os.environ.get("BENCH_OVERLAP_WORKERS", "8")))
+    if n < 2:
+        # a 1-device native platform has no wire: nothing to overlap,
+        # and every variant would time identically up to noise
+        print(json.dumps({
+            "metric": "overlap_skipped", "reason": "single device",
+            "platform": devices[0].platform,
+        }))
+        return 0
+    dim = int(os.environ.get("BENCH_OVERLAP_DIM", "2048" if on_tpu else "512"))
+    layers = int(os.environ.get("BENCH_OVERLAP_LAYERS", "8" if on_tpu else "6"))
+    batch = int(os.environ.get("BENCH_OVERLAP_BATCH", "128" if on_tpu else "32"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "10" if on_tpu else "5")))
+    windows = max(1, int(os.environ.get("BENCH_WINDOWS", "5" if on_tpu else "3")))
+    bucket_bytes = int(
+        os.environ.get("BENCH_OVERLAP_BUCKET_BYTES", str(1 << 20))
+    )
+
+    bf.init(devices=devices[:n])
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+
+    rng = np.random.RandomState(0)
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    x_np = rng.randn(n, batch, dim).astype(np.float32)
+    y_np = rng.randn(n, batch, dim).astype(np.float32)
+
+    def make_params():
+        return {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+
+    xs = bf.worker_values(lambda r: x_np[r])
+    ys = bf.worker_values(lambda r: y_np[r])
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    n_elems = layers * dim * dim
+    ctx = bf.get_context()
+
+    def new_opt():
+        return bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+
+    def fused_stepper(opt, **kwargs):
+        train_step = bf.make_train_step(opt, loss_fn, **kwargs)
+        params = make_params()
+        state = opt.init(params)
+        carry = [(params, state)]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs, ys)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, train_step, carry
+
+    def fused_hlo(opt, carry):
+        """Optimized HLO of this variant's fused program."""
+        p, s = carry[0]
+        return opt.lower_last_fused_hlo(p, s, xs, ys)
+
+    variants = ("no_comm", "two_program", "fused", "fused_buckets",
+                "delayed")
+    env_caps = {
+        "two_program": "0",  # cap irrelevant: one payload, legacy path
+        "fused": "0",
+        "fused_buckets": str(bucket_bytes),
+        "delayed": str(bucket_bytes),
+        "no_comm": "0",
+    }
+    old_cap = os.environ.get("BLUEFOG_BUCKET_BYTES")
+    # an ambient BLUEFOG_OVERLAP=0 would short-circuit bucket_bytes_cap()
+    # and silently compile the bucketed variants monolithic — the
+    # published evidence would describe programs that were never built
+    old_overlap = os.environ.get("BLUEFOG_OVERLAP")
+    os.environ["BLUEFOG_OVERLAP"] = "1"
+    steppers = {}
+    hlo_texts = {}
+
+    # restore belongs in finally: bucket_bytes_cap() reads the env on
+    # every optimizer dispatch, so an exception mid-bench (XLA OOM, a
+    # degenerate-platform abort) must not leak the last variant's cap
+    # into the caller's process
+    try:
+        for variant in variants:
+            os.environ["BLUEFOG_BUCKET_BYTES"] = env_caps[variant]
+            if variant == "two_program":
+                # the pre-overlap reality: the caller's grad program and
+                # the optimizer's gossip+update program are separate
+                # dispatches — every ppermute round fully exposed
+                # between them
+                opt = new_opt()
+                params = make_params()
+                state = opt.init(params)
+                spec = P("workers")
+
+                def grad_body(p_b, x_b, y_b):
+                    p = jax.tree_util.tree_map(lambda t: t[0], p_b)
+                    g = jax.grad(loss_fn)(p, x_b[0], y_b[0])
+                    return jax.tree_util.tree_map(
+                        lambda t: jnp.expand_dims(t, 0), g
+                    )
+
+                grad_fn = jax.jit(
+                    jax.shard_map(
+                        grad_body, mesh=ctx.mesh,
+                        in_specs=(spec, spec, spec), out_specs=spec,
+                    )
+                )
+                carry = [(params, state)]
+
+                def _step(carry=carry, grad_fn=grad_fn, opt=opt):
+                    p, s = carry[0]
+                    g = grad_fn(p, xs, ys)
+                    p, s = opt.step(p, s, g)
+                    carry[0] = (p, s)
+                    return p["w0"][0, 0, 0]  # scalar settle target
+
+                steppers[variant] = _step
+            else:
+                opt = new_opt()
+                if variant == "no_comm":
+                    opt.communication_type = bf.CommunicationType.empty
+                kwargs = {"delayed": True} if variant == "delayed" else {}
+                _step, train_step, carry = fused_stepper(opt, **kwargs)
+                steppers[variant] = _step
+                _step()  # compile now, under this variant's bucket cap
+                if variant in ("fused", "fused_buckets", "delayed"):
+                    hlo_texts[variant] = fused_hlo(opt, carry)
+
+        # INTERLEAVED windows (same rationale as BENCH_MODE=gossip): the
+        # comparison is a ratio of separately-timed variants, and
+        # ambient drift between sequential phases would read as fake
+        # overlap gains; round-robin windows expose every variant to the
+        # same conditions.
+        dts = {v: [] for v in variants}
+        degens = {v: 0 for v in variants}  # stall-clamped window count
+        for _ in range(windows):
+            for variant in variants:
+                os.environ["BLUEFOG_BUCKET_BYTES"] = env_caps[variant]
+                ts_w, degen = _timed_differenced(
+                    steppers[variant], steps, 1, with_degenerate=True
+                )
+                if degen:
+                    degens[variant] += 1
+                else:
+                    dts[variant] += ts_w
+    finally:
+        if old_cap is None:
+            os.environ.pop("BLUEFOG_BUCKET_BYTES", None)
+        else:
+            os.environ["BLUEFOG_BUCKET_BYTES"] = old_cap
+        if old_overlap is None:
+            os.environ.pop("BLUEFOG_OVERLAP", None)
+        else:
+            os.environ["BLUEFOG_OVERLAP"] = old_overlap
+    results = {
+        v: (min(dts[v]) if dts[v] else 0.0, not dts[v]) for v in variants
+    }
+
+    floor, floor_degen = results["no_comm"]
+    for variant in ("two_program", "fused", "fused_buckets", "delayed"):
+        dt, degen = results[variant]
+        exposed = max(dt - floor, 0.0)
+        line = {
+            "metric": "overlap_step",
+            "variant": variant,
+            "n_workers": n,
+            "payload_mb": round(n_elems * 4 / 1e6, 2),
+            "ms_per_step": round(dt * 1e3, 3),
+            "compute_floor_ms": round(floor * 1e3, 3),
+            "exposed_comm_ms": round(exposed * 1e3, 3),
+        }
+        if floor > 0:
+            line["gossip_overhead_pct"] = round(100.0 * exposed / floor, 2)
+        if degens[variant]:
+            # partial stalls: the published best-of excludes them, but
+            # the sample size shrank — disclose, don't hide
+            line["degenerate_windows"] = degens[variant]
+            line["clean_windows"] = len(dts[variant])
+        if degen or floor_degen:
+            # every window clamped: the value is a floor artifact
+            line["degenerate"] = True
+        print(json.dumps(line))
+
+    bounds = col_inner.bucket_bounds(n_elems, 4, bucket_bytes)
+    print(json.dumps({
+        "metric": "overlap_buckets",
+        "bucket_bytes_cap": bucket_bytes,
+        "n_buckets": len(bounds),
+        "bucket_elems": [b - a for a, b in bounds[:16]],
+    }))
+
+    for variant, txt in hlo_texts.items():
+        scan = scan_overlap(txt)
+        print(json.dumps({
+            "metric": "overlap_hlo",
+            "variant": variant,
+            "platform": devices[0].platform,
+            **{k: v for k, v in scan.items() if k != "permutes"},
+        }))
+        if variant in ("fused_buckets", "delayed"):
+            # schedule-order timeline: one event per bucket-round permute
+            print(json.dumps({
+                "metric": "overlap_bucket_timeline",
+                "variant": variant,
+                "events": [
+                    {
+                        "name": p["name"],
+                        "kind": p["kind"],
+                        "payload_bytes": p["payload_bytes"],
+                        "start_pos": p["start_pos"],
+                        "done_pos": p["done_pos"],
+                        "overlapped_compute": p["compute_between"],
+                        "independent_compute_ops":
+                            p["independent_compute_ops"],
+                    }
+                    for p in scan["permutes"][:32]
+                ],
+            }))
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        degenerate = any(d for _t, d in results.values())
+        if not degenerate:
+            # the acceptance pair: fused+buckets must leave LESS
+            # communication exposed than the two-program baseline
+            two = results["two_program"][0] - floor
+            fb = results["fused_buckets"][0] - floor
+            assert fb < two, (
+                f"fused+buckets exposed comm {fb*1e3:.3f} ms is not below "
+                f"the two-program baseline {two*1e3:.3f} ms"
+            )
+        if on_tpu:
+            scan = scan_overlap(hlo_texts["fused_buckets"])
+            assert scan["overlapped_async_pairs"] >= 1, (
+                "TPU fused program shows no async collective-permute "
+                "pair overlapping compute: "
+                f"{ {k: v for k, v in scan.items() if k != 'permutes'} }"
+            )
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -806,7 +1109,8 @@ def run_all() -> int:
     out the headline), headline last for tail-reading drivers."""
     import subprocess
 
-    for mode in ("scaling", "plan", "gossip", "flash", "transformer"):
+    for mode in ("scaling", "plan", "overlap", "gossip", "flash",
+                 "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -843,6 +1147,8 @@ def main() -> int:
         return run_scaling()
     if mode == "plan":
         return run_plan()
+    if mode == "overlap":
+        return run_overlap()
     if mode == "gossip":
         return run_gossip_overhead()
     if mode == "transformer":

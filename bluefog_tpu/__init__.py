@@ -110,6 +110,31 @@ from bluefog_tpu.collective.ops import (
 )
 
 
+# -- fused train step (overlap layer) ----------------------------------------
+
+
+def make_train_step(optimizer, loss_fn, has_aux: bool = False,
+                    delayed: bool = False):
+    """Compile ``loss_fn`` + backward + inner update + gossip into ONE
+    program so XLA can overlap the ppermute rounds with compute.
+
+    Free-function facade over ``optimizer.make_train_step`` for any of the
+    gossip-family distributed optimizers::
+
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+        train_step = bf.make_train_step(opt, loss_fn)
+        params, opt_state, loss = train_step(params, opt_state, batch)
+
+    ``delayed=True`` mixes each step against the previous step's payload
+    (one-step-stale gossip), removing communication from the critical path
+    entirely; see :meth:`bluefog_tpu.optimizers._GossipOptimizer.make_train_step`
+    and docs/performance.md for semantics and the staleness caveat.
+    """
+    return optimizer.make_train_step(
+        loss_fn, has_aux=has_aux, delayed=delayed
+    )
+
+
 # -- size / rank queries (reference basics.py:112-201) -----------------------
 
 
@@ -271,6 +296,7 @@ __all__ = [
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
     "win_associated_p",
+    "make_train_step",
     "CommunicationType",
     "DistributedGradientAllreduceOptimizer",
     "DistributedAllreduceOptimizer",

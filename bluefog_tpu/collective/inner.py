@@ -13,7 +13,8 @@ Every function takes a per-worker array (the shard_map block) plus an
 """
 
 import functools
-from typing import Optional, Tuple
+import os
+from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -21,6 +22,8 @@ from jax import lax
 from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 
 __all__ = [
+    "bucket_bytes_cap",
+    "bucket_bounds",
     "weighted_combine",
     "weighted_combine_operands",
     "weighted_combine_quantized",
@@ -38,6 +41,58 @@ __all__ = [
     "pair_gossip",
     "barrier",
 ]
+
+
+# Quantization chunk width (see _chunk_quantize): bucket boundaries snap to
+# it so a bucketed quantized payload partitions into exactly the chunks the
+# monolithic payload would — bucketing never moves an element into a
+# different scale group.
+_QUANT_CHUNK = 512
+
+
+def bucket_bytes_cap() -> int:
+    """The gossip bucket size cap in bytes, from the environment.
+
+    ``BLUEFOG_BUCKET_BYTES`` (default 4 MiB, the same order as Horovod's
+    fusion-buffer threshold) caps each wire payload; a dtype group larger
+    than the cap is split into independent size-capped buckets, each
+    issuing its own plan rounds, so XLA's scheduler can pipeline bucket
+    k+1's compute-side work behind bucket k's transfer instead of
+    serializing everything behind one monolithic concat.
+    ``BLUEFOG_OVERLAP=0`` disables bucketing entirely (one payload per
+    dtype group, the pre-bucketing behavior); 0 means "no cap".
+    """
+    if os.environ.get("BLUEFOG_OVERLAP", "1").lower() in ("0", "false", "off"):
+        return 0
+    return int(os.environ.get("BLUEFOG_BUCKET_BYTES", str(4 << 20)))
+
+
+def bucket_bounds(
+    n_elems: int, itemsize: int, cap_bytes: Optional[int] = None
+) -> List[Tuple[int, int]]:
+    """Contiguous ``[start, end)`` bucket bounds for a flat payload.
+
+    ``cap_bytes <= 0`` (or a payload under the cap) yields one bucket.
+    Bucket width is ALWAYS a multiple of the int8-quantization chunk
+    (512 elements): snapped down when the cap allows, clamped UP to one
+    chunk for sub-chunk caps. Either way the quantized wire's per-chunk
+    scales are identical whether or not the payload was bucketed — a
+    256-element bucket would chunk-quantize on different boundaries and
+    silently break the bitwise bucketed==monolithic guarantee; the exact
+    (unquantized) combine is elementwise and needs no alignment at all.
+    Splitting is pure slicing of the flat vector — element order never
+    changes, so bucketed and monolithic gossip are bitwise-identical
+    math.
+    """
+    if cap_bytes is None:
+        cap_bytes = bucket_bytes_cap()
+    if cap_bytes <= 0 or n_elems == 0:
+        return [(0, n_elems)]
+    per = max(1, cap_bytes // max(1, itemsize))
+    per = max(per - per % _QUANT_CHUNK, _QUANT_CHUNK)
+    if per >= n_elems:
+        return [(0, n_elems)]
+    return [(i, min(i + per, n_elems)) for i in range(0, n_elems, per)]
 
 
 def _weight_dtype(x: jnp.ndarray) -> jnp.dtype:

@@ -6,10 +6,17 @@ module forward/backward hooks so it overlaps compute
 (``torch/optimizers.py:166-1554``); the combine order distinguishes the
 families — CTA (combine-then-adapt: gossip the weights, then take the
 local optimizer step) vs ATC (adapt-then-combine: step first, gossip the
-result). On TPU the hook machinery is unnecessary: the whole training step
-— gradient, inner optax update, and the gossip collective — is ONE jitted
-shard_map program, and XLA overlaps the ppermute rounds with whatever
-compute is adjacent. The reference's hand-rolled inner sgd/adam/rmsprop/
+result). On TPU there are two execution shapes. ``opt.step(params, state,
+grads)`` compiles the update + gossip into one jitted shard_map program —
+but the caller's forward/backward is a SEPARATE program, and XLA cannot
+overlap collectives with compute across a program boundary: every ppermute
+round in ``step`` sits fully exposed on the critical path between the two
+dispatches. ``opt.make_train_step(loss_fn)`` removes that boundary — it
+fuses forward, backward, inner update, and the gossip combine into ONE
+program, the only place XLA's latency-hiding scheduler can actually run
+the ppermute rounds concurrently with backward/update compute (see
+``docs/performance.md`` "Overlapping communication with compute").
+The reference's hand-rolled inner sgd/adam/rmsprop/
 adagrad/adadelta re-implementations (optimizers.py:564-842) collapse into
 "pass any optax transformation".
 
@@ -90,9 +97,27 @@ def _dtype_groups(leaves):
     return sorted(groups.items())
 
 
-def _packed_gossip(tree, gossip_fn, step, wops):
-    """Apply a gossip combine to a whole pytree with ONE wire payload per
-    dtype group per round.
+def _bucketed_flat_gossip(flat, gossip_fn, step, wops, cap_bytes):
+    """Gossip a flat payload in size-capped buckets (Horovod-style).
+
+    Each bucket issues its own plan rounds, so independent buckets'
+    ppermutes can pipeline — bucket k+1's combine arithmetic overlaps
+    bucket k's transfer — instead of the whole model serializing behind
+    one monolithic payload. Slicing a flat vector never reorders
+    elements, and the combine is elementwise per element, so bucketed
+    output is bitwise-identical to the monolithic combine (quantized
+    wires included: bounds snap to the 512-element scale chunk)."""
+    bounds = inner.bucket_bounds(flat.size, flat.dtype.itemsize, cap_bytes)
+    if len(bounds) == 1:
+        return gossip_fn(flat, step, wops)
+    return jnp.concatenate(
+        [gossip_fn(flat[a:b], step, wops) for a, b in bounds]
+    )
+
+
+def _packed_gossip(tree, gossip_fn, step, wops, cap_bytes=0):
+    """Apply a gossip combine to a whole pytree, packed per dtype group
+    and split into size-capped wire buckets.
 
     XLA does not combine per-leaf collective-permutes (a 6-leaf ATC step
     over a 3-round plan compiles to 18 of them — verified by
@@ -104,16 +129,28 @@ def _packed_gossip(tree, gossip_fn, step, wops):
     single ppermute payload per round, at the price of one concat/split
     (a fused HBM copy) per step. Grouping by dtype keeps the wire policy
     intact — bf16 leaves gossip in bf16, never promoted by packing.
+
+    ``cap_bytes`` > 0 re-splits each packed payload into independent
+    buckets (:func:`bluefog_tpu.collective.inner.bucket_bounds`) so the
+    scheduler can pipeline them; 0 keeps one payload per dtype group.
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = [None] * len(leaves)
     for _dt, idxs in _dtype_groups(leaves):
         if len(idxs) == 1:
             i = idxs[0]
-            out[i] = gossip_fn(leaves[i], step, wops)
+            l = leaves[i]
+            bounds = inner.bucket_bounds(l.size, l.dtype.itemsize, cap_bytes)
+            if len(bounds) == 1:
+                out[i] = gossip_fn(l, step, wops)
+            else:
+                res = _bucketed_flat_gossip(
+                    l.reshape(-1), gossip_fn, step, wops, cap_bytes
+                )
+                out[i] = res.reshape(l.shape)
             continue
         flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-        res = gossip_fn(flat, step, wops)
+        res = _bucketed_flat_gossip(flat, gossip_fn, step, wops, cap_bytes)
         off = 0
         for i in idxs:
             n = leaves[i].size
@@ -122,16 +159,39 @@ def _packed_gossip(tree, gossip_fn, step, wops):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def _packed_gossip_ef(tree, ef_blocks, ef_combine):
+def _packed_gossip_ef(tree, ef_blocks, ef_combine, cap_bytes=0):
     """Like :func:`_packed_gossip` but with sender error-feedback state:
     one f32 residual vector per dtype group, threaded through the combine
-    (``ef_combine(flat, e) -> (y, e_new)``). Returns (tree', ef')."""
+    (``ef_combine(flat, e) -> (y, e_new)``). Returns (tree', ef').
+
+    Bucketing slices the residual state with the payload (the state is
+    positional over the same flat vector), so each bucket carries its own
+    error feedback and the reassembled state layout is unchanged."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = [None] * len(leaves)
     ef_out = []
     for gi, (_dt, idxs) in enumerate(_dtype_groups(leaves)):
         flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
-        y, e_new = ef_combine(flat, ef_blocks[gi])
+        bounds = inner.bucket_bounds(
+            flat.size, flat.dtype.itemsize, cap_bytes
+        )
+        e_self, e_recv = ef_blocks[gi]
+        if len(bounds) == 1:
+            y, e_new = ef_combine(flat, (e_self, e_recv))
+        else:
+            ys, e_selfs, e_recvs = [], [], []
+            for a, b in bounds:
+                yb, (es, er) = ef_combine(
+                    flat[a:b], (e_self[a:b], e_recv[:, a:b])
+                )
+                ys.append(yb)
+                e_selfs.append(es)
+                e_recvs.append(er)
+            y = jnp.concatenate(ys)
+            e_new = (
+                jnp.concatenate(e_selfs),
+                jnp.concatenate(e_recvs, axis=1),
+            )
         ef_out.append(e_new)
         off = 0
         for i in idxs:
@@ -139,6 +199,77 @@ def _packed_gossip_ef(tree, ef_blocks, ef_combine):
             out[i] = y[off:off + n].reshape(leaves[i].shape)
             off += n
     return jax.tree_util.tree_unflatten(treedef, out), tuple(ef_out)
+
+
+def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
+                    ef, ef_state, p, s, g):
+    """The gossip+inner-update core shared by :meth:`_GossipOptimizer.step`
+    and the fused builder (:meth:`_GossipOptimizer.make_train_step`).
+
+    One implementation, two callers, so the fused train step is
+    bitwise-identical math to the legacy two-program path by construction
+    (pinned by tests/test_overlap.py). Runs inside a shard_map block on
+    UNSTACKED (per-worker) trees; returns ``(p, s, ef_state')``.
+    """
+    if order == "grad":
+        # order='grad' only exists with allreduce communication
+        # (DistributedGradientAllreduceOptimizer)
+        g = _packed_gossip(
+            g,
+            lambda t, _s, _w: inner.allreduce(
+                t, ctx_mod.WORKER_AXIS, average=True
+            ),
+            step,
+            wops,
+            cap_bytes,
+        )
+
+    def communicate(tree, ef_st):
+        if ef:
+            return _packed_gossip_ef(
+                tree,
+                ef_st,
+                lambda flat, e: gossip_fn(flat, e, wops),
+                cap_bytes,
+            )
+        return _packed_gossip(tree, gossip_fn, step, wops, cap_bytes), ef_st
+
+    if order == "cta":
+        p, ef_state = communicate(p, ef_state)
+    updates, s = tx.update(g, s, p)
+    p = optax.apply_updates(p, updates)
+    if order == "atc":
+        p, ef_state = communicate(p, ef_state)
+    return p, s, ef_state
+
+
+def _pack_groups(tree):
+    """Per-dtype-group flat packed payloads of an UNSTACKED tree, in
+    :func:`_dtype_groups` order — the wire layout `_packed_gossip` uses."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return tuple(
+        jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        if len(idxs) > 1
+        else leaves[idxs[0]].reshape(-1)
+        for _dt, idxs in _dtype_groups(leaves)
+    )
+
+
+def _unpack_groups(tree, groups):
+    """Scatter per-dtype-group flat packed values back onto a tree's
+    leaves; the inverse of :func:`_pack_groups`."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = list(leaves)
+    for gi, (_dt, idxs) in enumerate(_dtype_groups(leaves)):
+        y = groups[gi]
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = y[off:off + n].reshape(
+                leaves[i].shape
+            ).astype(leaves[i].dtype)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _tree_restack(tree):
@@ -343,6 +474,54 @@ class _GossipOptimizer:
             )
         raise AssertionError(comm)
 
+    def _self_weight_fn(self, ctx):
+        """Per-rank SELF weight of the active combine, as a traced
+        ``fn(step, wops) -> scalar``, for the delayed (one-step-stale) mix.
+
+        The stale combine is ``y = C(buf) + s * (x - buf)``: wire payloads
+        come from the stale buffer (so the ppermutes depend on nothing the
+        current step computes), but the receiver swaps the stale SELF
+        contribution ``s * buf`` for the fresh ``s * x``. That
+        "self-fresh, neighbors-stale" recursion is the AD-PSGD-family
+        stale-mixing form, stable for every row-stochastic nonnegative
+        weight matrix (each root t of ``t^2 - s t - (lam - s)`` has
+        ``|t| <= 1`` because Gershgorin puts ``|lam - s| <= 1 - s``),
+        where the naive ``y = x + C(buf) - buf`` delta recursion diverges
+        whenever the mixing matrix has eigenvalues left of ``Re = 0``.
+        """
+        comm = self.communication_type
+        if comm == CommunicationType.empty:
+            return lambda step, wops: jnp.float32(1.0)
+        if comm == CommunicationType.allreduce:
+            inv_n = 1.0 / ctx.size
+            return lambda step, wops: jnp.float32(inv_n)
+        if self.schedule is not None:
+            sched = self.schedule
+            sw = jnp.asarray(
+                np.stack([p.self_weights for p in sched.plans]),
+                jnp.float32,
+            )
+
+            def from_schedule(step, wops):
+                idx = jax.lax.axis_index(ctx_mod.WORKER_AXIS)
+                return sw[step % sched.period, idx]
+
+            return from_schedule
+        if self.compression in ("int8", "bf16"):
+            # quantized path carries only recv_w (wops[0], [rounds, size]);
+            # the plan is validated normalized, so s = 1 - sum_r recv_w
+            def from_recv(step, wops):
+                idx = jax.lax.axis_index(ctx_mod.WORKER_AXIS)
+                return 1.0 - wops[0][:, idx].astype(jnp.float32).sum()
+
+            return from_recv
+
+        def from_operands(step, wops):  # exact path: wops = (self_w, recv_w)
+            idx = jax.lax.axis_index(ctx_mod.WORKER_AXIS)
+            return wops[0][idx].astype(jnp.float32)
+
+        return from_operands
+
     def _validate_compression(self):
         """Central knob validation for BOTH the flat and hierarchical
         paths: a silently-ignored or trace-time-erroring knob would make
@@ -491,31 +670,25 @@ class _GossipOptimizer:
 
     # -- the step ------------------------------------------------------------
 
-    def step(self, params, opt_state, grads):
-        """One decentralized optimization step; returns (params, opt_state).
-
-        The whole step is one compiled SPMD program (reference splits it
-        across hooks + synchronize + inner step, optimizers.py:362-482).
-        """
-        ctx = ctx_mod.get_context()
-        self._validate_compression()
+    def _comm_now(self) -> bool:
+        """Communicate on the K-th call (reference torch/optimizers.py:321);
+        validates the K knob on every dispatch."""
         k = int(self.num_steps_per_communication)
         if k < 1:
             raise ValueError(
                 "num_steps_per_communication must be a positive int, got "
                 f"{self.num_steps_per_communication!r}"
             )
-        comm_now = self._step_count % k == k - 1  # communicate on K-th call
-        if not comm_now and self.order == "grad":
-            # between communications, gradient order accumulates and leaves
-            # params/state untouched (reference _DistributedOptimizer's
-            # reduce-delay accumulation, optimizers.py:347,443)
-            self._step_count += 1
-            self._grad_accum = (
-                grads if self._grad_accum is None
-                else self._tree_add(ctx, self._grad_accum, grads)
-            )
-            return params, opt_state
+        return self._step_count % k == k - 1
+
+    def _resolve_dispatch(self, ctx, params, comm_now):
+        """The dispatch prologue shared by :meth:`step` and the fused
+        builder: mesh/spec selection, gossip resolution, error-feedback
+        state. One implementation so a new communication type or
+        validation rule cannot reach one path and skip the other.
+        Returns ``(hier, mesh, spec, gossip_key, gossip_fn, wops, ef,
+        cap_bytes)``."""
+        self._validate_compression()
         hier = (
             self.communication_type
             == CommunicationType.hierarchical_neighbor_allreduce
@@ -539,9 +712,35 @@ class _GossipOptimizer:
         ef = comm_now and not hier and self.compression == "int8_ef"
         if ef:
             self._ensure_ef_state(ctx, params, spec, gossip_key[1])
+        return (
+            hier, mesh, spec, gossip_key, gossip_fn, wops, ef,
+            inner.bucket_bytes_cap(),
+        )
+
+    def step(self, params, opt_state, grads):
+        """One decentralized optimization step; returns (params, opt_state).
+
+        The whole step is one compiled SPMD program (reference splits it
+        across hooks + synchronize + inner step, optimizers.py:362-482).
+        """
+        ctx = ctx_mod.get_context()
+        comm_now = self._comm_now()
+        if not comm_now and self.order == "grad":
+            # between communications, gradient order accumulates and leaves
+            # params/state untouched (reference _DistributedOptimizer's
+            # reduce-delay accumulation, optimizers.py:347,443)
+            self._step_count += 1
+            self._grad_accum = (
+                grads if self._grad_accum is None
+                else self._tree_add(ctx, self._grad_accum, grads)
+            )
+            return params, opt_state
+        (
+            hier, mesh, spec, gossip_key, gossip_fn, wops, ef, cap_bytes,
+        ) = self._resolve_dispatch(ctx, params, comm_now)
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
-            self._tx_version, ef,
+            self._tx_version, ef, cap_bytes,
         ) + tuple(gossip_key) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
@@ -553,41 +752,15 @@ class _GossipOptimizer:
                 s = _tree_block(state_b)
                 g = _tree_block(grads_b)
                 step = step[0]
-                ef_out = ef_b
-                if order == "grad":
-                    # order='grad' only exists with allreduce communication
-                    # (DistributedGradientAllreduceOptimizer)
-                    g = _packed_gossip(
-                        g,
-                        lambda t, _s, _w: inner.allreduce(
-                            t, ctx_mod.WORKER_AXIS, average=True
-                        ),
-                        step,
-                        wops,
-                    )
-
-                def communicate(tree, ef_state):
-                    if ef:
-                        return _packed_gossip_ef(
-                            tree,
-                            tuple(
-                                (sb[0], rb[0]) for sb, rb in ef_state
-                            ),
-                            lambda flat, e: gossip_fn(flat, e, wops),
-                        )
-                    return _packed_gossip(tree, gossip_fn, step, wops), ef_state
-
-                if order == "cta":
-                    p, ef_out = communicate(p, ef_out)
-                updates, s = tx.update(g, s, p)
-                p = optax.apply_updates(p, updates)
-                if order == "atc":
-                    p, ef_out = communicate(p, ef_out)
-                if ef:
-                    ef_out = tuple(
-                        (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
-                        for sb, rb in ef_out
-                    )
+                ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
+                p, s, ef_out = _combine_update(
+                    order, tx, gossip_fn, wops, step, cap_bytes,
+                    ef, ef_in, p, s, g,
+                )
+                ef_out = tuple(
+                    (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
+                    for sb, rb in ef_out
+                )
                 return _tree_restack(p), _tree_restack(s), ef_out
 
             fn = jax.jit(
@@ -617,8 +790,297 @@ class _GossipOptimizer:
             self._ef = ef_out
         return params_out, opt_state
 
+    # -- the fused train step (overlap layer) --------------------------------
+
+    def _ensure_delay_state(self, ctx, mesh, params, spec, struct_key):
+        """Double buffer for ``delayed=True``: one worker-stacked flat
+        payload per dtype group, holding the PREVIOUS step's gossip input
+        (pre-update params for CTA, post-update for ATC). Seeded from the
+        current params — step 0's combine is then exactly the fresh
+        combine, and staleness starts at step 1. Rebuilt whenever the
+        parameter avals or the communication structure change (a stale
+        buffer under a new edge set would mix against the wrong sources,
+        same invalidation rule as the error-feedback copies)."""
+        from jax.sharding import NamedSharding
+
+        leaves = jax.tree_util.tree_leaves(params)
+        sig = (
+            tuple(
+                (dt, sum(int(np.prod(leaves[i].shape[1:])) for i in idxs))
+                for dt, idxs in _dtype_groups(leaves)
+            ),
+            struct_key,
+        )
+        if getattr(self, "_delay_sig", None) == sig:
+            return
+        sharding = NamedSharding(mesh, spec)
+        size = ctx.size
+        bufs = []
+        for _dt, idxs in _dtype_groups(leaves):
+            flat = jnp.concatenate(
+                [jnp.reshape(leaves[i], (size, -1)) for i in idxs], axis=1
+            )
+            bufs.append(jax.device_put(flat, sharding))
+        self._delay_buf = tuple(bufs)
+        self._delay_sig = sig
+
+    def make_train_step(self, loss_fn, has_aux: bool = False,
+                        delayed: bool = False):
+        """Build the fused train step: forward, backward, inner optax
+        update, and the gossip combine in ONE compiled shard_map program.
+
+        ``loss_fn(params, *batch) -> loss`` (or ``(loss, aux)`` with
+        ``has_aux=True``) is evaluated per worker on UNSTACKED trees; the
+        returned callable takes worker-stacked operands::
+
+            train_step = opt.make_train_step(loss_fn)
+            params, opt_state, loss = train_step(params, opt_state, *batch)
+
+        Why this exists: ``opt.step`` is its own program, so the caller's
+        backward pass and the gossip collective live in different XLA
+        programs and can never overlap — every ppermute round is exposed
+        on the step critical path. Inside one program, XLA's
+        latency-hiding scheduler hoists each round's ppermute start above
+        independent backward/update compute and sinks the wait below it,
+        hiding the transfer (the in-XLA analogue of the reference's
+        backward-hook overlap, torch/optimizers.py:166-1554, and of the
+        fused weight-update design in "Automatic Cross-Replica Sharding
+        of Weight Update in Data-Parallel Training"). The math is the
+        shared :func:`_combine_update` core, so fused and two-program
+        paths are bitwise-identical (tests/test_overlap.py).
+
+        ``delayed=True`` (ATC/CTA only) takes communication off the
+        critical path entirely: the combine at step k mixes the payload
+        double-buffered from step k-1, so the ppermutes depend ONLY on a
+        carried buffer — zero data dependency on this step's
+        forward/backward — and the scheduler can run them concurrently
+        with the whole step. The cost is one-step-stale mixing, a
+        known-convergent decentralized-SGD variant (the same staleness
+        family as asynchronous gossip; consensus and convergence are
+        preserved, constants degrade slightly — see docs/performance.md
+        for the caveat). ``compression='int8_ef'`` is refused with
+        ``delayed=True``: the error-feedback copies integrate the payload
+        round by round, and a one-step-stale payload would desynchronize
+        sender and receiver copies, breaking the bit-identical-replica
+        invariant that scheme relies on.
+        """
+        if self.order not in ("cta", "atc", "grad"):
+            raise AssertionError(self.order)
+        if delayed and self.order == "grad":
+            raise ValueError(
+                "delayed=True applies to the weight-gossip families "
+                "(CTA/ATC); gradient allreduce has no stale-mix variant"
+            )
+        value_and_grad = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        # Per-builder cache-key component: two builders over the same
+        # optimizer may close over different loss functions.
+        fused_uid = next(_opt_uid)
+
+        def train_step(params, opt_state, *batch):
+            ctx = ctx_mod.get_context()
+            if delayed and self.compression == "int8_ef":
+                raise ValueError(
+                    "compression='int8_ef' cannot carry error feedback "
+                    "across a one-step delay (the CHOCO copies would "
+                    "integrate a stale payload and desynchronize); use "
+                    "delayed=False or compression in (None,'int8','bf16')"
+                )
+            comm_now = self._comm_now()
+            (
+                hier, mesh, spec, gossip_key, gossip_fn, wops, ef,
+                cap_bytes,
+            ) = self._resolve_dispatch(ctx, params, comm_now)
+            if delayed and hier:
+                raise ValueError(
+                    "delayed=True is not supported for hierarchical "
+                    "communication (the intra-machine psum leg has no "
+                    "stale-mix form); use flat neighbor_allreduce or "
+                    "delayed=False"
+                )
+            delay_now = delayed and comm_now
+            self_weight_fn = (
+                self._self_weight_fn(ctx) if delay_now else None
+            )
+            if delay_now:
+                self._ensure_delay_state(ctx, mesh, params, spec, gossip_key)
+            accum = (
+                self._grad_accum
+                if comm_now and self.order == "grad" else None
+            )
+            key = (
+                "opt_fused_step", fused_uid, self.order,
+                self.communication_type, self._uid, self._tx_version, ef,
+                delay_now, cap_bytes, accum is not None,
+            ) + tuple(gossip_key) + _aval_key((params, opt_state, batch))
+            fn = ctx.op_cache.get(key)
+            if fn is None:
+                order = self.order
+                tx = self._tx
+                has_accum = accum is not None
+
+                def body(params_b, state_b, step, wops, ef_b, buf_b,
+                         accum_b, *batch_b):
+                    p = _tree_block(params_b)
+                    s = _tree_block(state_b)
+                    bat = tuple(_tree_block(b) for b in batch_b)
+                    step = step[0]
+                    if delay_now:
+                        # The stale combine's wire legs FIRST, on the
+                        # carried buffers: these ppermutes depend on
+                        # nothing this step computes, so the scheduler is
+                        # free to run them under the forward/backward
+                        # below. Only the cheap elementwise self-swap
+                        # (see _self_weight_fn) touches fresh values.
+                        bufs = tuple(b[0] for b in buf_b)
+                        combined = tuple(
+                            _bucketed_flat_gossip(
+                                b, gossip_fn, step, wops, cap_bytes
+                            )
+                            for b in bufs
+                        )
+                        sw = self_weight_fn(step, wops)
+
+                        def stale_mix(tree):
+                            fresh = _pack_groups(tree)
+                            return _unpack_groups(tree, tuple(
+                                c + sw.astype(c.dtype)
+                                * (x.astype(c.dtype) - b.astype(c.dtype))
+                                for c, x, b in zip(combined, fresh, bufs)
+                            ))
+                    if has_aux:
+                        (loss, aux), grads = value_and_grad(p, *bat)
+                    else:
+                        loss, grads = value_and_grad(p, *bat)
+                        aux = ()
+                    if order == "grad" and not comm_now:
+                        # accumulation call: params/state untouched, the
+                        # gradient comes OUT to the host-side accumulator
+                        return (
+                            _tree_restack(p), _tree_restack(s),
+                            jnp.reshape(loss, (1,)),
+                            _tree_restack(aux) if has_aux else (),
+                            (), _tree_restack(grads),
+                        )
+                    if has_accum:
+                        grads = jax.tree_util.tree_map(
+                            jnp.add, _tree_block(accum_b), grads
+                        )
+                    if delay_now:
+                        if order == "cta":
+                            new_buf = _pack_groups(p)
+                            p = stale_mix(p)
+                            updates, s = tx.update(grads, s, p)
+                            p = optax.apply_updates(p, updates)
+                        else:  # atc
+                            updates, s = tx.update(grads, s, p)
+                            p = optax.apply_updates(p, updates)
+                            new_buf = _pack_groups(p)
+                            p = stale_mix(p)
+                        buf_out = tuple(
+                            jnp.expand_dims(b, 0) for b in new_buf
+                        )
+                        ef_out = ()
+                    else:
+                        ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
+                        p, s, ef_out = _combine_update(
+                            order, tx, gossip_fn, wops, step, cap_bytes,
+                            ef, ef_in, p, s, grads,
+                        )
+                        ef_out = tuple(
+                            (jnp.expand_dims(sb, 0),
+                             jnp.expand_dims(rb, 0))
+                            for sb, rb in ef_out
+                        )
+                        buf_out = ()
+                    return (
+                        _tree_restack(p), _tree_restack(s),
+                        jnp.reshape(loss, (1,)),
+                        _tree_restack(aux) if has_aux else (),
+                        ef_out, buf_out,
+                    )
+
+                n_batch = len(batch)
+                fn = jax.jit(
+                    jax.shard_map(
+                        body,
+                        mesh=mesh,
+                        in_specs=(spec, spec, P(), P(), spec, spec, spec)
+                        + (spec,) * n_batch,
+                        out_specs=(spec, spec, spec, spec, spec, spec),
+                    )
+                )
+                ctx.op_cache[key] = fn
+            step_idx = jnp.asarray([self._comm_count], jnp.int32)
+            self._step_count += 1
+            if comm_now:
+                self._comm_count += 1
+            ef_in = self._ef if ef else ()
+            buf_in = self._delay_buf if delay_now else ()
+            accum_in = accum if accum is not None else ()
+            # single source of truth for debug/evidence lowering
+            # (lower_last_fused_hlo): the compiled fn plus exactly the
+            # operand structure this dispatch used — as avals, not live
+            # arrays, so the hook never pins a superseded model-sized
+            # buffer generation in device memory
+            self._last_fused = (fn,) + tuple(
+                jax.tree_util.tree_map(
+                    lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), op
+                )
+                for op in (wops, ef_in, buf_in, accum_in)
+            )
+            if self.order == "grad" and not comm_now:
+                params_o, state_o, loss, aux, _ef_o, grads_o = (
+                    _timed_dispatch(
+                        "fused_train_step", fn, params, opt_state,
+                        step_idx, wops, ef_in, buf_in, accum_in, *batch,
+                    )
+                )
+                self._grad_accum = (
+                    grads_o if self._grad_accum is None
+                    else self._tree_add(ctx, self._grad_accum, grads_o)
+                )
+            else:
+                params_o, state_o, loss, aux, ef_o, buf_o = (
+                    _timed_dispatch(
+                        "fused_train_step", fn, params, opt_state,
+                        step_idx, wops, ef_in, buf_in, accum_in, *batch,
+                    )
+                )
+                if ef:
+                    self._ef = ef_o
+                if delay_now:
+                    self._delay_buf = buf_o
+                if comm_now and self.order == "grad":
+                    self._grad_accum = None
+            if has_aux:
+                return params_o, state_o, (loss, aux)
+            return params_o, state_o, loss
+
+        return train_step
+
+    def lower_last_fused_hlo(self, params, opt_state, *batch) -> str:
+        """Optimized HLO text of the most recently dispatched fused train
+        step, lowered against the given operands (only their avals
+        matter; the recorded dispatch operands are kept as
+        ShapeDtypeStructs). Evidence/debug hook for
+        ``BENCH_MODE=overlap`` and ``tests/test_overlap.py`` — it owns
+        the compiled fn's operand structure so callers never have to
+        poke cache-key internals."""
+        fn, wops, ef_in, buf_in, accum_in = self._last_fused
+        step_idx = jnp.asarray([0], jnp.int32)
+        return (
+            fn.lower(
+                params, opt_state, step_idx, wops, ef_in, buf_in,
+                accum_in, *batch,
+            )
+            .compile()
+            .as_text()
+        )
+
     def _tree_add(self, ctx, a, b):
-        key = ("opt_tree_add", self._uid) + _aval_key(a)
+        # keyed by avals only: identical tree-adds from different
+        # optimizer instances share one compiled program
+        key = ("opt_tree_add",) + _aval_key(a)
         fn = ctx.op_cache.get(key)
         if fn is None:
             fn = jax.jit(

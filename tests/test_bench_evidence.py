@@ -49,6 +49,48 @@ def test_scaling_mode_emits_flat_comm_evidence():
     assert len({l["wire_bytes_per_worker"] for l in comm}) == 1, comm
 
 
+def test_overlap_mode_emits_four_way_comparison():
+    """BENCH_MODE=overlap emits the two-program / fused / fused+buckets
+    / delayed comparison plus the bucket split and the static HLO
+    overlap scan (small sizes; the timing assertion is exercised by the
+    full-size bench run, not this smoke)."""
+    out, lines = _run_mode(
+        "overlap",
+        {
+            "BENCH_OVERLAP_DIM": "128", "BENCH_OVERLAP_LAYERS": "3",
+            "BENCH_OVERLAP_BATCH": "8", "BENCH_STEPS": "2",
+            "BENCH_WINDOWS": "2", "BENCH_OVERLAP_BUCKET_BYTES": "16384",
+            "BENCH_ASSERT": "0",
+        },
+        timeout=1200,
+    )
+    assert out.returncode == 0, (out.stderr[-2000:], lines)
+    steps = {
+        l["variant"]: l for l in lines if l.get("metric") == "overlap_step"
+    }
+    assert set(steps) == {
+        "two_program", "fused", "fused_buckets", "delayed"
+    }, lines
+    assert all("exposed_comm_ms" in l for l in steps.values())
+    buckets = [l for l in lines if l.get("metric") == "overlap_buckets"]
+    # 3 * 128 * 128 * 4B = 196 KiB over a 16 KiB cap -> many buckets
+    assert buckets and buckets[0]["n_buckets"] > 1, lines
+    hlo = {
+        l["variant"]: l for l in lines if l.get("metric") == "overlap_hlo"
+    }
+    assert set(hlo) == {"fused", "fused_buckets", "delayed"}, lines
+    for l in hlo.values():
+        # every permute must be accounted for, async (TPU) or sync (CPU)
+        assert l["async_pairs"] + l["sync_collective_permutes"] > 0, l
+    # the delayed program's permutes consume only the carried buffer:
+    # statically overlappable on any backend
+    assert hlo["delayed"]["overlappable_permutes"] > 0, hlo["delayed"]
+    timeline = [
+        l for l in lines if l.get("metric") == "overlap_bucket_timeline"
+    ]
+    assert any(l["events"] for l in timeline), lines
+
+
 def _on_tpu_host() -> bool:
     return os.environ.get("BLUEFOG_AMBIENT_PLATFORM", "") == "axon"
 
