@@ -36,6 +36,10 @@ Individual families via ``BENCH_MODE``:
   per-bucket schedule timeline, and the static HLO overlap scan
   (``tools/hlo_overlap_scan.py``). See docs/performance.md
   "Overlapping communication with compute".
+- ``metrics``: telemetry-overhead evidence — the fused gossip step
+  timed with the device metric tier off vs on (interval 10), the
+  bitwise on/off state pin, and a drained-registry sample; asserts the
+  <2 % overhead acceptance bound. See ``docs/metrics.md``.
 
 Timing windows that come out degenerate (a clamped ``diff <= 0`` in
 ``timed_differenced`` — an ambient stall ate the differenced half) are
@@ -916,6 +920,247 @@ def run_overlap() -> int:
     return 0
 
 
+def run_metrics() -> int:
+    """Metrics-overhead evidence: the same fused gossip train step timed
+    with the telemetry device tier off vs on (``BLUEFOG_METRICS=1``,
+    interval 10) on the 8-worker CPU mesh, plus the bitwise pin that
+    enabling metrics does not move the training state, and a sample of
+    the drained registry. The acceptance bound — <2 % step-time
+    overhead — is asserted here so the committed METRICS_EVIDENCE.json
+    is re-checked by every bench run.
+
+    Measurement protocol — per-sample delta, analytically amortized.
+    Direct wall-clock A/B at interval 10 cannot resolve <2 % on a
+    shared host: the A/A (off vs off) control of both window-level and
+    step-level paired protocols was measured swinging +-5 % run to run
+    (ambient load states are autocorrelated at the seconds scale). The
+    <2 % claim decomposes into two facts that ARE resolvable:
+
+    1. Unsampled steps (interval-1 of every interval) dispatch the SAME
+       compiled program as metrics-off — verified structurally here by
+       toggling BLUEFOG_METRICS on the same optimizer and asserting no
+       new op-cache entry appears. Zero overhead by construction.
+    2. The sampled step's incremental cost (metric-instrumented program
+       + drain swap) is measured directly by running the on-stepper at
+       interval=1 — every step pays it — against the off-stepper in a
+       step-level rotation (all orderings, position bias cancels).
+       Resolving the PER-SAMPLE delta needs only ~20 % resolution for a
+       2 % amortized bound, well above the noise floor; the published
+       ``overhead_pct`` is that delta divided by the interval. An
+       off/off A/A control runs the identical protocol and is published
+       amortized the same way as the method's noise floor."""
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_METRICS_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import metrics as bf_metrics
+
+    devices = jax.devices()
+    n = min(len(devices), int(os.environ.get("BENCH_METRICS_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_METRICS_DIM", "512"))
+    layers = int(os.environ.get("BENCH_METRICS_LAYERS", "12"))
+    batch = int(os.environ.get("BENCH_METRICS_BATCH", "32"))
+    interval = int(os.environ.get("BLUEFOG_METRICS_INTERVAL", "10"))
+    samples = max(
+        30, int(os.environ.get("BENCH_METRICS_SAMPLES", "150"))
+    )
+
+    bf.init(devices=devices[:n])
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+
+    rng = np.random.RandomState(0)
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    xs = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+    ys = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def make_stepper():
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+        train_step = bf.make_train_step(opt, loss_fn)
+        params = {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+        carry = [(params, opt.init(params))]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs, ys)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, carry
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_METRICS", "BLUEFOG_METRICS_INTERVAL",
+                  "BLUEFOG_METRICS_FILE", "BLUEFOG_METRICS_PROM")
+    }
+    # no exporter I/O inside the timed loop: the evidence bounds the
+    # in-graph computation + the interval-amortized drain readback
+    os.environ.pop("BLUEFOG_METRICS_FILE", None)
+    os.environ.pop("BLUEFOG_METRICS_PROM", None)
+    os.environ["BLUEFOG_METRICS_INTERVAL"] = str(interval)
+    # "on" runs at interval=1 so EVERY timed step pays the sampled
+    # program + drain; "off2" is the A/A control: a second metrics-off
+    # stepper measured with the same protocol, so the published number
+    # comes with the methodology's own noise floor next to it.
+    env_cfg = {"off": ("0", None), "on": ("1", "1"), "off2": ("0", None)}
+
+    def set_env(variant):
+        met, iv = env_cfg[variant]
+        os.environ["BLUEFOG_METRICS"] = met
+        os.environ["BLUEFOG_METRICS_INTERVAL"] = iv or str(interval)
+
+    try:
+        import itertools
+        import time as time_mod
+
+        steppers = {}
+        carries = {}
+        for variant in ("off", "on", "off2"):
+            set_env(variant)
+            steppers[variant], carries[variant] = make_stepper()
+            steppers[variant]()  # compile under this variant's config
+            steppers[variant]()  # and the on-variant's drain path
+            _settle(steppers[variant]())
+
+        # structural fact 1: with metrics enabled, an off-boundary
+        # (unsampled) dispatch reuses the metrics-off compiled program —
+        # toggling the flag on the SAME stepper adds no op-cache entry
+        ctx = bf.get_context()
+        os.environ["BLUEFOG_METRICS"] = "0"
+        steppers["off"]()
+        n_cache = len(ctx.op_cache)
+        # the off-stepper's comm count is already past 0, so with a huge
+        # interval this enabled dispatch is off-boundary == unsampled
+        os.environ["BLUEFOG_METRICS"] = "1"
+        os.environ["BLUEFOG_METRICS_INTERVAL"] = "1000000000"
+        steppers["off"]()
+        unsampled_shared = len(ctx.op_cache) == n_cache
+        set_env("off")
+
+        orders = list(itertools.permutations(("off", "on", "off2")))
+        times = {v: [] for v in steppers}
+        for i in range(samples):
+            for variant in orders[i % len(orders)]:
+                set_env(variant)
+                t0 = time_mod.perf_counter()
+                _settle(steppers[variant]())
+                times[variant].append(time_mod.perf_counter() - t0)
+
+        pairs = list(zip(times["off"], times["on"]))
+        control_pairs = list(zip(times["off"], times["off2"]))
+
+        # bitwise pin, fresh state both ways, same step count, at the
+        # published interval (so both sampled and unsampled dispatches
+        # are exercised on the metrics-on side)
+        state_bits = {}
+        os.environ["BLUEFOG_METRICS_INTERVAL"] = str(interval)
+        for variant in ("off", "on"):
+            os.environ["BLUEFOG_METRICS"] = env_cfg[variant][0]
+            _step, carry = make_stepper()
+            for _ in range(12):
+                _step()
+            state_bits[variant] = jax.tree_util.tree_leaves(carry[0])
+        bitwise = all(
+            bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(state_bits["off"], state_bits["on"])
+        )
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def median(v):
+        v = sorted(v)
+        return v[len(v) // 2] if v else 0.0
+
+    degenerate = not pairs
+    base_s = median(times["off"])
+    # per-SAMPLE incremental cost (ms): paired per-step deltas, median
+    sample_extra_s = median([on - off for off, on in pairs])
+    control_extra_s = median([o2 - off for off, o2 in control_pairs])
+    # amortized: one sampled step per interval, the rest are the shared
+    # metrics-off program (unsampled_shared above)
+    overhead_pct = (
+        100.0 * sample_extra_s / interval / base_s if base_s > 0 else 0.0
+    )
+    control_pct = (
+        100.0 * control_extra_s / interval / base_s if base_s > 0 else 0.0
+    )
+    line = {
+        "metric": "metrics_overhead",
+        "n_workers": n,
+        "payload_mb": round(layers * dim * dim * 4 / 1e6, 2),
+        "interval": interval,
+        "ms_per_step_off": round(base_s * 1e3, 3),
+        "ms_sampled_step_extra": round(sample_extra_s * 1e3, 3),
+        "unsampled_program_shared": unsampled_shared,
+        "overhead_pct": round(overhead_pct, 3),
+        # A/A control: what the same protocol+amortization reports for
+        # two IDENTICAL metrics-off steppers — the honest noise floor
+        "control_aa_pct": round(control_pct, 3),
+        "bitwise_identical": bitwise,
+        "samples": len(pairs),
+    }
+    if degenerate:
+        line["degenerate"] = True
+    print(json.dumps(line))
+
+    bf_metrics.flush()  # fold any deferred drains before sampling
+    snap = bf_metrics.snapshot()
+    sample = {
+        k: v.get("value")
+        for k, v in snap.items()
+        if k.startswith("bluefog.gossip.") or k in (
+            "bluefog.wire_bytes", "bluefog.comm_steps",
+            "bluefog.recompiles",
+        )
+    }
+    print(json.dumps({"metric": "metrics_snapshot_sample", **sample}))
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        assert bitwise, (
+            "enabling metrics changed the training state bitwise"
+        )
+        assert unsampled_shared, (
+            "unsampled metrics-on dispatch did not reuse the "
+            "metrics-off compiled program"
+        )
+        if not degenerate:
+            assert overhead_pct < 2.0, (
+                f"metrics overhead {overhead_pct:.2f}% exceeds the 2% "
+                "acceptance bound at interval "
+                f"{interval}"
+            )
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -1109,8 +1354,8 @@ def run_all() -> int:
     out the headline), headline last for tail-reading drivers."""
     import subprocess
 
-    for mode in ("scaling", "plan", "overlap", "gossip", "flash",
-                 "transformer"):
+    for mode in ("scaling", "plan", "overlap", "metrics", "gossip",
+                 "flash", "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -1149,6 +1394,8 @@ def main() -> int:
         return run_plan()
     if mode == "overlap":
         return run_overlap()
+    if mode == "metrics":
+        return run_metrics()
     if mode == "gossip":
         return run_gossip_overhead()
     if mode == "transformer":

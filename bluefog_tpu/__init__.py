@@ -85,6 +85,15 @@ from bluefog_tpu.timeline import (
     timeline_context,
 )
 from bluefog_tpu.logging_util import logger, set_log_level
+from bluefog_tpu import metrics
+from bluefog_tpu.metrics import (
+    metrics_export,
+    snapshot as metrics_snapshot,
+)
+from bluefog_tpu.timeline import (
+    timeline_record_counter,
+    timeline_record_instant,
+)
 from bluefog_tpu.watchdog import set_stall_timeout
 from bluefog_tpu.watchdog import suspend, resume
 from bluefog_tpu.collective.ops import (
@@ -315,7 +324,12 @@ __all__ = [
     "timeline_enabled",
     "timeline_start_activity",
     "timeline_end_activity",
+    "timeline_record_instant",
+    "timeline_record_counter",
     "timeline_context",
+    "metrics",
+    "metrics_snapshot",
+    "metrics_export",
     "logger",
     "set_log_level",
     "set_stall_timeout",

@@ -251,12 +251,16 @@ def compile_edges(
         raise ValueError(
             f"method must be 'auto', 'offset' or 'coloring', got {method!r}"
         )
+    from bluefog_tpu import metrics
+
     payload = DEFAULT_PAYLOAD_BYTES if payload_bytes is None else payload_bytes
     canon = _canonical(edges, size)
     key = (canon, size, method, payload)
     hit = _COMPILE_CACHE.get(key)
     if hit is not None:
+        metrics.counter("bluefog.plan_cache.hits").inc()
         return hit
+    metrics.counter("bluefog.plan_cache.misses").inc()
 
     naive = offset_perms(canon, size)
     bound = min_rounds(canon, size)

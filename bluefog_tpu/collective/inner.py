@@ -46,7 +46,10 @@ __all__ = [
 # Quantization chunk width (see _chunk_quantize): bucket boundaries snap to
 # it so a bucketed quantized payload partitions into exactly the chunks the
 # monolithic payload would — bucketing never moves an element into a
-# different scale group.
+# different scale group. The metrics layer's probe subsamples and its
+# host-side quantization-error replay align to the same width
+# (bluefog_tpu.metrics), so sampled chunk scales stay bit-identical to
+# the transmitted ones.
 _QUANT_CHUNK = 512
 
 

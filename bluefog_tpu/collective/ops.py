@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import metrics
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
 from bluefog_tpu.collective import inner
@@ -163,6 +164,9 @@ def _compiled(ctx, name, key, fn, in_specs, out_specs, mesh=None):
     cache_key = (name,) + tuple(key)
     cached = ctx.op_cache.get(cache_key)
     if cached is None:
+        # new program build (retrace): the metric every cache-key bug
+        # shows up in first — a healthy loop recompiles O(1) times total
+        metrics.counter("bluefog.recompiles").inc()
         jitted = jax.jit(
             jax.shard_map(
                 fn, mesh=mesh or ctx.mesh, in_specs=in_specs, out_specs=out_specs

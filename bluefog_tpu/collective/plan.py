@@ -152,6 +152,20 @@ class CommPlan:
                 out[j, k] = src_round[j][s]
         return out
 
+    def wire_bytes(self, n_elems: int, itemsize: int = 4,
+                   wire: Optional[str] = None) -> int:
+        """Per-worker wire bytes one gossip step over this plan ships for
+        an ``n_elems``-element payload (every round re-ships it; quantized
+        wires swap the payload dtype — see
+        :func:`bluefog_tpu.metrics.wire_bytes_per_step`). The per-edge
+        traffic number the metrics layer exports as
+        ``bluefog.wire_bytes``."""
+        from bluefog_tpu import metrics
+
+        return metrics.wire_bytes_per_step(
+            {itemsize: n_elems}, len(self.rounds), wire
+        )
+
     def weight_matrix(self) -> np.ndarray:
         """Reconstruct the effective combine matrix ``W`` (W[i, j] = weight
         rank j applies to rank i's value). For testing/inspection."""

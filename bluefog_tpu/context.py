@@ -323,9 +323,15 @@ def init(
         )
     # Reference behavior: BLUEFOG_TIMELINE=<prefix> activates tracing at
     # init (operations.cc:464-473).
+    from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
     _tl.maybe_init_from_env()
+    # Mesh-shape gauges: every metrics export carries the context the
+    # series were recorded under (a JSONL file divorced from its run is
+    # otherwise uninterpretable).
+    _metrics.gauge("bluefog.size").set(_context.size)
+    _metrics.gauge("bluefog.machine_size").set(_context.machine_size)
     return _context
 
 
@@ -335,8 +341,15 @@ def shutdown() -> None:
     timeline the user opened with ``timeline_init`` stays open (it is
     theirs to close)."""
     global _context
+    from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
+    # Final flush of deferred device drains + the env-configured
+    # exporters (JSONL / Prometheus / timeline counters) BEFORE an
+    # env-owned timeline closes, so the last drained values land in both
+    # the files and the trace.
+    _metrics.flush()
+    _metrics.auto_export()
     if _tl.timeline_env_owned():
         _tl.timeline_shutdown()
     with _lock:

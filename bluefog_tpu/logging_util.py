@@ -42,8 +42,16 @@ def set_log_level(level: str) -> None:
     logger.setLevel(_LEVELS[level.lower()])
 
 
+# Bad BLUEFOG_LOG_LEVEL values warned about already: the fallback to
+# `warn` must be loud exactly once per value, not once per reconfigure —
+# a typo'd level (`vrbose`) silently eating the user's intended verbosity
+# was only discoverable by reading this file.
+_warned_levels = set()
+
+
 def _configure_from_env() -> None:
-    level = os.environ.get("BLUEFOG_LOG_LEVEL", "warn").lower()
+    raw = os.environ.get("BLUEFOG_LOG_LEVEL")
+    level = (raw or "warn").lower()
     logger.setLevel(_LEVELS.get(level, logging.WARNING))
     if not logger.handlers:
         handler = logging.StreamHandler()
@@ -54,6 +62,12 @@ def _configure_from_env() -> None:
         handler.setFormatter(logging.Formatter(fmt))
         logger.addHandler(handler)
         logger.propagate = False
+    if raw is not None and level not in _LEVELS and level not in _warned_levels:
+        _warned_levels.add(level)
+        logger.warning(
+            "unknown BLUEFOG_LOG_LEVEL %r; falling back to 'warn' "
+            "(accepted: %s)", raw, ", ".join(sorted(_LEVELS)),
+        )
 
 
 _configure_from_env()

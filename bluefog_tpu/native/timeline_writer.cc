@@ -28,8 +28,10 @@ struct Record {
   long long ts_us;
   int pid;        // worker rank (chrome "process")
   long long tid;  // lane within the worker
-  char ph;        // 'B' begin, 'E' end, 'X' complete, 'i' instant
+  char ph;        // 'B' begin, 'E' end, 'X' complete, 'i' instant,
+                  // 'C' counter
   long long dur_us;
+  double value = 0.0;  // counter value ('C' records only)
   std::string name;
   std::string cat;
 };
@@ -103,6 +105,9 @@ class TimelineWriter {
                  r.ts_us, r.pid, r.tid);
     if (r.ph == 'X') std::fprintf(file_, ", \"dur\": %lld", r.dur_us);
     if (r.ph == 'i') std::fputs(", \"s\": \"p\"", file_);
+    // counter events carry their series value in args (chrome renders
+    // them as stacked area tracks)
+    if (r.ph == 'C') std::fprintf(file_, ", \"args\": {\"value\": %g}", r.value);
     std::fputs("}", file_);
   }
 
@@ -167,6 +172,22 @@ void bf_timeline_record_complete(const char* name, const char* category,
   r.tid = tid;
   r.ph = 'X';
   r.dur_us = dur_us;
+  r.name = name == nullptr ? "" : name;
+  r.cat = category == nullptr ? "" : category;
+  g_writer.Add(std::move(r));
+}
+
+// Counter event (ph 'C'): a named scalar sampled now — the timeline
+// exporter of the metrics registry (bluefog_tpu/metrics.py).
+void bf_timeline_record_counter(const char* name, const char* category,
+                                int pid, long long tid, double value) {
+  Record r;
+  r.ts_us = NowUs();
+  r.pid = pid;
+  r.tid = tid;
+  r.ph = 'C';
+  r.dur_us = 0;
+  r.value = value;
   r.name = name == nullptr ? "" : name;
   r.cat = category == nullptr ? "" : category;
   g_writer.Add(std::move(r));

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import optax
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
 from bluefog_tpu.collective import inner, ops as col_ops
@@ -202,29 +203,73 @@ def _packed_gossip_ef(tree, ef_blocks, ef_combine, cap_bytes=0):
 
 
 def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
-                    ef, ef_state, p, s, g):
+                    ef, ef_state, p, s, g, wire=None, with_metrics=False):
     """The gossip+inner-update core shared by :meth:`_GossipOptimizer.step`
     and the fused builder (:meth:`_GossipOptimizer.make_train_step`).
 
     One implementation, two callers, so the fused train step is
     bitwise-identical math to the legacy two-program path by construction
     (pinned by tests/test_overlap.py). Runs inside a shard_map block on
-    UNSTACKED (per-worker) trees; returns ``(p, s, ef_state')``.
+    UNSTACKED (per-worker) trees; returns ``(p, s, ef_state', mvec)``.
+
+    ``with_metrics=True`` additionally computes the gossip-health metric
+    row (:func:`bluefog_tpu.metrics.build_probe_payload`) from the
+    combine's own intermediates — purely extra *outputs*, never touching
+    the values that feed ``p``/``s``, so metrics on/off stays
+    bitwise-identical for the training state (tests/test_metrics.py);
+    ``mvec`` is None when off. ``wire`` names the quantized wire in use
+    so the metric row can include its quantization error.
     """
+    mvec = None
+    allreduce_fn = lambda t, _s, _w: inner.allreduce(
+        t, ctx_mod.WORKER_AXIS, average=True
+    )
+
+    def probe(tree, ef_st, comb_fn):
+        """The metrics SUB-GOSSIP: slice a 512-aligned prefix of the
+        packed combine INPUT (touching inputs is free) and run the SAME
+        wire on just that subsample — the combine is elementwise (and
+        chunk-local for the quantized wires, with 512-aligned prefixes
+        preserving chunk boundaries), so the tiny combine's output is
+        bitwise the restriction of the full combine. The BIG combine's
+        outputs are never consumed: any metric path touching them
+        (tree-domain or packed, sliced or reduced) was measured to
+        derail the CPU backend's schedule by ~a third of a step."""
+        pairs = []
+        for gi, (sub, scale) in enumerate(
+            _packed_prefix(tree, metrics_mod.sample_elems_cap())
+        ):
+            if ef:
+                e_self, e_recv = ef_st[gi]
+                k = sub.shape[0]
+                # restriction of the CHOCO combine: state slices are
+                # INPUT values; the probe's updated copies are exported
+                # for the residual metric and then discarded
+                y_sub, (es_new, _er_new) = comb_fn(
+                    sub, (e_self[:k], e_recv[:, :k]), wops
+                )
+                pairs.append((sub, y_sub, scale, es_new))
+            else:
+                y_sub = comb_fn(sub, step, wops)
+                pairs.append((sub, y_sub, scale, None))
+        g_subs = (
+            _packed_prefix(g, metrics_mod.sample_elems_cap())
+            if g is not None else ()
+        )
+        return metrics_mod.build_probe_payload(pairs, g_subs, wire=wire)
+
     if order == "grad":
         # order='grad' only exists with allreduce communication
-        # (DistributedGradientAllreduceOptimizer)
-        g = _packed_gossip(
-            g,
-            lambda t, _s, _w: inner.allreduce(
-                t, ctx_mod.WORKER_AXIS, average=True
-            ),
-            step,
-            wops,
-            cap_bytes,
-        )
+        # (DistributedGradientAllreduceOptimizer); the "iterate" on the
+        # wire IS the local gradient: disagreement = ||g_avg - g_local||
+        if with_metrics:
+            mvec = probe(g, ef_state, allreduce_fn)
+        g = _packed_gossip(g, allreduce_fn, step, wops, cap_bytes)
 
     def communicate(tree, ef_st):
+        nonlocal mvec
+        if with_metrics and order in ("cta", "atc"):
+            mvec = probe(tree, ef_st, gossip_fn)
         if ef:
             return _packed_gossip_ef(
                 tree,
@@ -240,7 +285,7 @@ def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
     p = optax.apply_updates(p, updates)
     if order == "atc":
         p, ef_state = communicate(p, ef_state)
-    return p, s, ef_state
+    return p, s, ef_state, mvec
 
 
 def _pack_groups(tree):
@@ -253,6 +298,36 @@ def _pack_groups(tree):
         else leaves[idxs[0]].reshape(-1)
         for _dt, idxs in _dtype_groups(leaves)
     )
+
+
+def _packed_prefix(tree, cap):
+    """``[(sub_flat, scale)]`` per dtype group: a 512-aligned prefix of
+    the group's PACKED flat, built directly from whole input leaves
+    plus at most one partial leaf slice — so only O(cap) elements are
+    ever concatenated and only INPUT values are consumed. ``scale``
+    (= group elems / covered elems) restores whole-group squared-sum
+    estimates on the host; 1.0 (exact) when the group fits the cap.
+    The 512 alignment matches the quantization chunk, keeping the
+    metrics sub-gossip's chunk scales bit-identical to the full wire's
+    for the covered region (:mod:`bluefog_tpu.metrics`)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = []
+    for _dt, idxs in _dtype_groups(leaves):
+        total = sum(int(leaves[i].size) for i in idxs)
+        keep = min(total, max(512, cap - cap % 512))
+        parts = []
+        got = 0
+        for i in idxs:
+            if got >= keep:
+                break
+            n = int(leaves[i].size)
+            take = min(n, keep - got)
+            flat = leaves[i].reshape(-1)
+            parts.append(flat if take == n else flat[:take])
+            got += take
+        sub = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        out.append((sub, total / keep))
+    return out
 
 
 def _unpack_groups(tree, groups):
@@ -344,6 +419,14 @@ class _GossipOptimizer:
         self._step_count = 0
         self._comm_count = 0  # schedule index: advances per communication
         self._grad_accum = None  # grad-order local accumulator (sum)
+        # Device-tier metrics: the 1-in-BLUEFOG_METRICS_INTERVAL sampled
+        # step additionally OUTPUTS a pytree of tiny subsample slices
+        # (metrics.build_probe_payload); the host folds the previous
+        # sample's payload — by then long copied back — into the
+        # registry at each new sample.
+        self._pending_drain = None  # (wire, payload) copying to host
+        self._metrics_hooked = False
+        self._acct_cache: dict = {}  # per-program wire-byte accounting
 
     @property
     def tx(self):
@@ -717,6 +800,101 @@ class _GossipOptimizer:
             inner.bucket_bytes_cap(),
         )
 
+    # -- device-tier metrics plumbing ----------------------------------------
+
+    def _metrics_wire(self, comm_now, hier):
+        """The quantized-wire name for this dispatch's metric row, or
+        None. Hierarchical compression quantizes the machine-level
+        local_sum (not the packed tree payload the metric helper sees),
+        so its quantization error is not computed — the flat-path wires
+        are the ones with a well-defined per-worker payload here."""
+        if not comm_now or hier or self.schedule is not None:
+            return None
+        if self.compression in ("int8", "bf16", "int8_ef"):
+            return self.compression
+        return None
+
+    @staticmethod
+    def _fold_pending(pending, export):
+        wire, payload = pending
+        payload = jax.tree_util.tree_map(np.asarray, payload)
+        metrics_mod.fold_device_payload(payload, wire=wire, export=export)
+
+    def _drain_after_sample(self, wire, payload):
+        """After a sampled dispatch, stash its subsample payload and
+        START the device->host copy (``copy_to_host_async``); the
+        registry fold happens at the NEXT sample (or at an explicit
+        :func:`bluefog_tpu.metrics.flush`), by which point the copy has
+        long completed. A synchronous ``np.asarray`` here would block
+        the host mid-loop and forfeit a dispatch-pipeline's worth of
+        overlap per drain."""
+        if not self._metrics_hooked:
+            # flush hook: bf.metrics_export()/shutdown fold the pending
+            # payload so exports never miss the tail of a run
+            metrics_mod.register_flush_hook(self)
+            self._metrics_hooked = True
+        if self._pending_drain is not None:
+            self._fold_pending(self._pending_drain, export=True)
+        for leaf in jax.tree_util.tree_leaves(payload):
+            try:
+                leaf.copy_to_host_async()
+            except AttributeError:  # non-jax.Array stand-ins in tests
+                pass
+        self._pending_drain = (wire, payload)
+
+    def _flush_metrics(self):
+        """Fold the pending payload into the registry now (no exporter
+        side effects — the caller, :func:`bluefog_tpu.metrics.flush`,
+        owns what happens next)."""
+        if self._pending_drain is not None:
+            self._fold_pending(self._pending_drain, export=False)
+            self._pending_drain = None
+
+    def _record_comm_accounting(self, key, gossip_key, params, ctx):
+        """Host-tier per-dispatch accounting: ppermute rounds and wire
+        bytes for this communicating step (static per compiled program,
+        so the numbers are computed once per cache key). TopoOpt-style
+        per-edge traffic planning starts from exactly this counter."""
+        acct = self._acct_cache.get(key)
+        if acct is None:
+            tag = gossip_key[0]
+            wire = None
+            rounds = 0
+            if tag in ("na", "na_q_ef", "hier"):
+                rounds = len(gossip_key[-1])
+                wire = "int8_ef" if tag == "na_q_ef" else None
+            elif tag in ("na_q", "hier_q"):
+                wire = gossip_key[1]
+                rounds = len(gossip_key[2])
+            elif isinstance(tag, SchedulePlan):
+                rounds = max(len(p.rounds) for p in tag.plans)
+            elif tag == "hier_sched":
+                rounds = max(len(p.rounds) for p in gossip_key[1].plans)
+            elif tag == "allreduce":
+                rounds = 1
+            leaves = jax.tree_util.tree_leaves(params)
+            by_item: dict = {}
+            for l in leaves:
+                n = int(np.prod(l.shape[1:])) if l.ndim > 1 else 1
+                item = np.dtype(l.dtype).itemsize
+                by_item[item] = by_item.get(item, 0) + n
+            if tag == "allreduce":
+                # ring allreduce ships ~2 (n-1)/n payloads per worker
+                payload = sum(i * n for i, n in by_item.items())
+                wire_bytes = int(
+                    2 * (ctx.size - 1) / max(ctx.size, 1) * payload
+                )
+            else:
+                wire_bytes = metrics_mod.wire_bytes_per_step(
+                    by_item, rounds, wire
+                )
+            acct = (rounds, wire_bytes)
+            self._acct_cache[key] = acct
+        rounds, wire_bytes = acct
+        metrics_mod.gauge("bluefog.gossip.rounds").set(rounds)
+        metrics_mod.counter("bluefog.wire_bytes").inc(wire_bytes)
+        metrics_mod.counter("bluefog.comm_steps").inc()
+
     def step(self, params, opt_state, grads):
         """One decentralized optimization step; returns (params, opt_state).
 
@@ -738,12 +916,24 @@ class _GossipOptimizer:
         (
             hier, mesh, spec, gossip_key, gossip_fn, wops, ef, cap_bytes,
         ) = self._resolve_dispatch(ctx, params, comm_now)
+        met_enabled = metrics_mod.enabled() and comm_now
+        # Two-program sampling: only the 1-in-interval sampled step pays
+        # the metric computation — every other step dispatches a program
+        # whose cache key EQUALS the metrics-off key, so 9 of 10 steps
+        # are the metrics-off program by construction (the design that
+        # keeps BENCH_MODE=metrics under its 2% bound; an in-graph
+        # lax.cond was measured to drag every step).
+        met = met_enabled and (
+            self._comm_count % metrics_mod.metrics_interval() == 0
+        )
+        wire_now = self._metrics_wire(comm_now, hier)
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
-            self._tx_version, ef, cap_bytes,
+            self._tx_version, ef, cap_bytes, met,
         ) + tuple(gossip_key) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
+            metrics_mod.counter("bluefog.recompiles").inc()
             order = self.order
             tx = self._tx
 
@@ -753,22 +943,25 @@ class _GossipOptimizer:
                 g = _tree_block(grads_b)
                 step = step[0]
                 ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
-                p, s, ef_out = _combine_update(
+                p, s, ef_out, mvec = _combine_update(
                     order, tx, gossip_fn, wops, step, cap_bytes,
-                    ef, ef_in, p, s, g,
+                    ef, ef_in, p, s, g, wire=wire_now, with_metrics=met,
                 )
                 ef_out = tuple(
                     (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
                     for sb, rb in ef_out
                 )
-                return _tree_restack(p), _tree_restack(s), ef_out
+                met_out = (
+                    (_tree_restack(mvec),) if met else ()
+                )
+                return _tree_restack(p), _tree_restack(s), ef_out, met_out
 
             fn = jax.jit(
                 jax.shard_map(
                     body,
                     mesh=mesh,
                     in_specs=(spec, spec, spec, P(), P(), spec),
-                    out_specs=(spec, spec, spec),
+                    out_specs=(spec, spec, spec, spec),
                 )
             )
             ctx.op_cache[key] = fn
@@ -782,12 +975,16 @@ class _GossipOptimizer:
         if comm_now:
             self._comm_count += 1
         ef_in = self._ef if ef else ()
-        params_out, opt_state, ef_out = _timed_dispatch(
+        if met_enabled:
+            self._record_comm_accounting(key, gossip_key, params, ctx)
+        params_out, opt_state, ef_out, met_out = _timed_dispatch(
             "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
             ef_in,
         )
         if ef:
             self._ef = ef_out
+        if met:
+            self._drain_after_sample(wire_now, met_out[0])
         return params_out, opt_state
 
     # -- the fused train step (overlap layer) --------------------------------
@@ -907,13 +1104,22 @@ class _GossipOptimizer:
                 self._grad_accum
                 if comm_now and self.order == "grad" else None
             )
+            met_enabled = metrics_mod.enabled() and comm_now
+            # two-program sampling, same rationale as in step(): only
+            # the 1-in-interval sampled dispatch compiles/pays for the
+            # metric outputs; the rest share the metrics-off program
+            met = met_enabled and (
+                self._comm_count % metrics_mod.metrics_interval() == 0
+            )
+            wire_now = self._metrics_wire(comm_now, hier)
             key = (
                 "opt_fused_step", fused_uid, self.order,
                 self.communication_type, self._uid, self._tx_version, ef,
-                delay_now, cap_bytes, accum is not None,
+                delay_now, cap_bytes, accum is not None, met,
             ) + tuple(gossip_key) + _aval_key((params, opt_state, batch))
             fn = ctx.op_cache.get(key)
             if fn is None:
+                metrics_mod.counter("bluefog.recompiles").inc()
                 order = self.order
                 tx = self._tx
                 has_accum = accum is not None
@@ -947,6 +1153,35 @@ class _GossipOptimizer:
                                 * (x.astype(c.dtype) - b.astype(c.dtype))
                                 for c, x, b in zip(combined, fresh, bufs)
                             ))
+
+                        def delayed_probe(tree, grads):
+                            """Metrics sub-gossip for the stale mix
+                            (same rationale as _combine_update's probe:
+                            never consume the big combine's outputs):
+                            re-run the mix on a 512-aligned prefix of
+                            the carried buffer + fresh packs — bitwise
+                            the restriction of the full stale combine."""
+                            cap = metrics_mod.sample_elems_cap()
+                            pairs = []
+                            for gi, (f_sub, scale) in enumerate(
+                                _packed_prefix(tree, cap)
+                            ):
+                                k = f_sub.shape[0]
+                                b_sub = bufs[gi][:k]
+                                c_sub = _bucketed_flat_gossip(
+                                    b_sub, gossip_fn, step, wops,
+                                    cap_bytes,
+                                )
+                                y_sub = c_sub + sw.astype(c_sub.dtype) * (
+                                    f_sub.astype(c_sub.dtype)
+                                    - b_sub.astype(c_sub.dtype)
+                                )
+                                pairs.append((f_sub, y_sub, scale, None))
+                            return metrics_mod.build_probe_payload(
+                                pairs,
+                                _packed_prefix(grads, cap),
+                                wire=None,
+                            )
                     if has_aux:
                         (loss, aux), grads = value_and_grad(p, *bat)
                     else:
@@ -959,15 +1194,22 @@ class _GossipOptimizer:
                             _tree_restack(p), _tree_restack(s),
                             jnp.reshape(loss, (1,)),
                             _tree_restack(aux) if has_aux else (),
-                            (), _tree_restack(grads),
+                            (), _tree_restack(grads), (),
                         )
                     if has_accum:
                         grads = jax.tree_util.tree_map(
                             jnp.add, _tree_block(accum_b), grads
                         )
+                    mvec = None
                     if delay_now:
                         if order == "cta":
                             new_buf = _pack_groups(p)
+                            if met:
+                                # delayed mix: delta measured against
+                                # the FRESH iterate (wire/EF metrics
+                                # have no stale-payload form, see
+                                # docs/metrics.md)
+                                mvec = delayed_probe(p, grads)
                             p = stale_mix(p)
                             updates, s = tx.update(grads, s, p)
                             p = optax.apply_updates(p, updates)
@@ -975,6 +1217,8 @@ class _GossipOptimizer:
                             updates, s = tx.update(grads, s, p)
                             p = optax.apply_updates(p, updates)
                             new_buf = _pack_groups(p)
+                            if met:
+                                mvec = delayed_probe(p, grads)
                             p = stale_mix(p)
                         buf_out = tuple(
                             jnp.expand_dims(b, 0) for b in new_buf
@@ -982,9 +1226,10 @@ class _GossipOptimizer:
                         ef_out = ()
                     else:
                         ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
-                        p, s, ef_out = _combine_update(
+                        p, s, ef_out, mvec = _combine_update(
                             order, tx, gossip_fn, wops, step, cap_bytes,
                             ef, ef_in, p, s, grads,
+                            wire=wire_now, with_metrics=met,
                         )
                         ef_out = tuple(
                             (jnp.expand_dims(sb, 0),
@@ -992,11 +1237,14 @@ class _GossipOptimizer:
                             for sb, rb in ef_out
                         )
                         buf_out = ()
+                    met_out = (
+                        (_tree_restack(mvec),) if met else ()
+                    )
                     return (
                         _tree_restack(p), _tree_restack(s),
                         jnp.reshape(loss, (1,)),
                         _tree_restack(aux) if has_aux else (),
-                        ef_out, buf_out,
+                        ef_out, buf_out, met_out,
                     )
 
                 n_batch = len(batch)
@@ -1006,7 +1254,9 @@ class _GossipOptimizer:
                         mesh=mesh,
                         in_specs=(spec, spec, P(), P(), spec, spec, spec)
                         + (spec,) * n_batch,
-                        out_specs=(spec, spec, spec, spec, spec, spec),
+                        out_specs=(
+                            spec, spec, spec, spec, spec, spec, spec,
+                        ),
                     )
                 )
                 ctx.op_cache[key] = fn
@@ -1017,6 +1267,8 @@ class _GossipOptimizer:
             ef_in = self._ef if ef else ()
             buf_in = self._delay_buf if delay_now else ()
             accum_in = accum if accum is not None else ()
+            if met_enabled:
+                self._record_comm_accounting(key, gossip_key, params, ctx)
             # single source of truth for debug/evidence lowering
             # (lower_last_fused_hlo): the compiled fn plus exactly the
             # operand structure this dispatch used — as avals, not live
@@ -1029,10 +1281,11 @@ class _GossipOptimizer:
                 for op in (wops, ef_in, buf_in, accum_in)
             )
             if self.order == "grad" and not comm_now:
-                params_o, state_o, loss, aux, _ef_o, grads_o = (
+                params_o, state_o, loss, aux, _ef_o, grads_o, _met_o = (
                     _timed_dispatch(
                         "fused_train_step", fn, params, opt_state,
-                        step_idx, wops, ef_in, buf_in, accum_in, *batch,
+                        step_idx, wops, ef_in, buf_in, accum_in,
+                        *batch,
                     )
                 )
                 self._grad_accum = (
@@ -1040,10 +1293,11 @@ class _GossipOptimizer:
                     else self._tree_add(ctx, self._grad_accum, grads_o)
                 )
             else:
-                params_o, state_o, loss, aux, ef_o, buf_o = (
+                params_o, state_o, loss, aux, ef_o, buf_o, met_o = (
                     _timed_dispatch(
                         "fused_train_step", fn, params, opt_state,
-                        step_idx, wops, ef_in, buf_in, accum_in, *batch,
+                        step_idx, wops, ef_in, buf_in, accum_in,
+                        *batch,
                     )
                 )
                 if ef:
@@ -1052,6 +1306,12 @@ class _GossipOptimizer:
                     self._delay_buf = buf_o
                 if comm_now and self.order == "grad":
                     self._grad_accum = None
+                if met:
+                    # the delayed probe measures the stale mix without a
+                    # wire payload (no quant/EF slots) — see delayed_probe
+                    self._drain_after_sample(
+                        None if delay_now else wire_now, met_o[0]
+                    )
             if has_aux:
                 return params_o, state_o, (loss, aux)
             return params_o, state_o, loss

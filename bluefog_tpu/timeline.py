@@ -35,6 +35,8 @@ __all__ = [
     "timeline_start_activity",
     "timeline_end_activity",
     "timeline_record_complete",
+    "timeline_record_instant",
+    "timeline_record_counter",
     "timeline_context",
 ]
 
@@ -109,12 +111,25 @@ class _PyWriter:
         )
 
     def bf_timeline_record(self, name, cat, ph, pid, tid) -> None:
+        ph = ph.decode()
+        # instant events need a scope field, same as the native Emit()
+        suffix = ', "s": "p"' if ph == "i" else ""
         self._emit(
             '{"name": "%s", "cat": "%s", "ph": "%s", "ts": %d, '
-            '"pid": %d, "tid": %d}'
+            '"pid": %d, "tid": %d%s}'
             % (
-                self._esc(name), self._esc(cat), ph.decode(),
-                self.bf_timeline_now_us(), pid, tid,
+                self._esc(name), self._esc(cat), ph,
+                self.bf_timeline_now_us(), pid, tid, suffix,
+            )
+        )
+
+    def bf_timeline_record_counter(self, name, cat, pid, tid, value):
+        self._emit(
+            '{"name": "%s", "cat": "%s", "ph": "C", "ts": %d, '
+            '"pid": %d, "tid": %d, "args": {"value": %g}}'
+            % (
+                self._esc(name), self._esc(cat),
+                self.bf_timeline_now_us(), pid, tid, value,
             )
         )
 
@@ -176,10 +191,16 @@ def _load_native():
                     ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
                     ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
                 ]
+                lib.bf_timeline_record_counter.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+                    ctypes.c_longlong, ctypes.c_double,
+                ]
                 lib.bf_timeline_now_us.restype = ctypes.c_longlong
                 _lib = lib
                 return _lib
-            except OSError:
+            except (OSError, AttributeError):
+                # AttributeError: a stale cached .so predating the
+                # counter entry point — fall through to the Python writer
                 pass
         _lib = _PyWriter()
         return _lib
@@ -266,6 +287,40 @@ def timeline_record_complete(name: str, activity: str, start_us: int,
     _load_native().bf_timeline_record_complete(
         name.encode(), activity.encode(), rank, tid, start_us, dur_us
     )
+
+
+def timeline_record_instant(name: str, activity: str = "", rank: int = 0,
+                            tid: int = 0) -> bool:
+    """One instant event (ph=i) — a point-in-time marker, e.g. a watchdog
+    stall report landing in the trace next to the span it interrupted."""
+    if not _active:
+        return False
+    _load_native().bf_timeline_record(
+        name.encode(), activity.encode(), b"i", rank, tid
+    )
+    return True
+
+
+def timeline_record_counter(name: str, value: float,
+                            activity: str = "COUNTER", rank: int = 0,
+                            tid: int = 0) -> bool:
+    """One counter event (ph=C): ``name`` sampled at ``value`` now.
+    Chrome/Perfetto render counter series as area tracks under the op
+    spans — the timeline exporter of :mod:`bluefog_tpu.metrics`.
+
+    Non-finite values are dropped (returns False): %g would serialize
+    them as bare ``nan``/``inf`` tokens and invalidate the WHOLE trace
+    file as JSON — precisely when training diverges and the trace is
+    most needed."""
+    import math
+
+    value = float(value)
+    if not _active or not math.isfinite(value):
+        return False
+    _load_native().bf_timeline_record_counter(
+        name.encode(), activity.encode(), rank, tid, value
+    )
+    return True
 
 
 def timeline_now_us() -> int:

@@ -93,6 +93,16 @@ def _monitor() -> None:
                         "rendezvous deadlock (block each dependent dispatch).",
                         name, waited, limit,
                     )
+                    # Stalls must reach the exported metrics and the trace,
+                    # not just stderr: a fleet pages on bluefog.stalls, and
+                    # the instant event lands in the timeline next to the
+                    # span that hung.
+                    from bluefog_tpu import metrics, timeline
+
+                    metrics.counter("bluefog.stalls").inc()
+                    timeline.timeline_record_instant(
+                        f"stall:{name}", "STALL"
+                    )
 
 
 class watch:

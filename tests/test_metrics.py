@@ -1,0 +1,361 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Metrics subsystem tests: registry semantics, exporters, the in-graph
+gossip-health device tier (numpy-oracled), and the load-bearing pin that
+enabling metrics never perturbs the training state.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+import bluefog_tpu.topology as tu
+from bluefog_tpu import metrics
+from bluefog_tpu.collective import ops as col_ops
+
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices, monkeypatch):
+    monkeypatch.delenv("BLUEFOG_METRICS", raising=False)
+    monkeypatch.delenv("BLUEFOG_METRICS_FILE", raising=False)
+    monkeypatch.delenv("BLUEFOG_METRICS_PROM", raising=False)
+    metrics.reset()
+    bf.init(devices=cpu_devices[:SIZE])
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    yield
+    bf.shutdown()
+    metrics.reset()
+
+
+# -- host-tier registry -------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    metrics.counter("c").inc()
+    metrics.counter("c").inc(2.5)
+    metrics.gauge("g").set(7)
+    h = metrics.histogram("h")
+    for v in (1.0, 3.0, 2.0):
+        h.observe(v)
+    snap = metrics.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 3.5}
+    assert snap["g"] == {"type": "gauge", "value": 7.0}
+    assert snap["h"]["count"] == 3 and snap["h"]["min"] == 1.0
+    assert snap["h"]["max"] == 3.0 and snap["h"]["last"] == 2.0
+
+
+def test_registry_rejects_type_conflict():
+    metrics.counter("series")
+    with pytest.raises(TypeError):
+        metrics.gauge("series")
+
+
+def test_facade_snapshot_and_export(tmp_path):
+    metrics.counter("bluefog.test").inc(4)
+    jsonl = str(tmp_path / "m.jsonl")
+    prom = str(tmp_path / "m.prom")
+    snap = bf.metrics_export(jsonl_path=jsonl, prom_path=prom)
+    assert snap["bluefog.test"]["value"] == 4.0
+    assert bf.metrics_snapshot()["bluefog.test"]["value"] == 4.0
+    (line,) = open(jsonl).read().splitlines()
+    obj = json.loads(line)
+    assert obj["metrics"]["bluefog.test"]["value"] == 4.0
+    text = open(prom).read()
+    assert "# TYPE bluefog_test_total counter" in text
+    assert "bluefog_test_total 4" in text
+
+
+def test_prom_export_sanitizes_and_types(tmp_path):
+    metrics.gauge("bluefog.gossip.rounds").set(3)
+    metrics.histogram("bluefog.lat").observe(0.5)
+    path = metrics.export_prom(str(tmp_path / "x.prom"))
+    text = open(path).read()
+    assert "bluefog_gossip_rounds 3" in text
+    assert "bluefog_lat_count 1" in text and "bluefog_lat_sum 0.5" in text
+    # no stray characters survive sanitization
+    for line in text.splitlines():
+        assert " " in line and not line.startswith("."), line
+
+
+# -- satellite: unknown log level warns once ----------------------------------
+
+
+def test_unknown_log_level_warns_once(monkeypatch, caplog):
+    from bluefog_tpu import logging_util
+
+    monkeypatch.setenv("BLUEFOG_LOG_LEVEL", "chatty-nonsense")
+    bf.logger.propagate = True
+    try:
+        with caplog.at_level("WARNING", logger="bluefog_tpu"):
+            logging_util._configure_from_env()
+            logging_util._configure_from_env()  # second call: silent
+    finally:
+        bf.logger.propagate = False
+        monkeypatch.delenv("BLUEFOG_LOG_LEVEL")
+        logging_util._configure_from_env()
+    warns = [
+        r for r in caplog.records if "BLUEFOG_LOG_LEVEL" in r.message
+    ]
+    assert len(warns) == 1, [r.message for r in caplog.records]
+    assert "chatty-nonsense" in warns[0].getMessage()
+    assert "trace" in warns[0].getMessage()  # names the accepted set
+
+
+# -- device tier: numpy oracle ------------------------------------------------
+
+
+def test_disagreement_matches_numpy_oracle(cpu_devices, monkeypatch):
+    """Consensus-distance oracle on a hand-built 4-node weighted digraph:
+    after one communicating step, the drained disagreement gauge equals
+    ``rms_i ||x_i - sum_j W[j, i] x_j||`` computed in numpy."""
+    import networkx as nx
+
+    n = 4
+    # weighted digraph: 0->1->2->3->0 plus 0->2, receiver-normalized
+    w = np.zeros((n, n))
+    np.fill_diagonal(w, [0.5, 0.6, 0.4, 0.7])
+    w[0, 1] = 0.4
+    w[1, 2] = 0.35
+    w[2, 3] = 0.3
+    w[3, 0] = 0.5
+    w[0, 2] = 0.25
+    assert np.allclose(w.sum(axis=0), 1.0)
+    g = nx.from_numpy_array(w, create_using=nx.DiGraph)
+    bf.init(devices=cpu_devices[:n])
+    bf.set_topology(g, is_weighted=True)
+
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
+    rng = np.random.RandomState(7)
+    x = rng.randn(n, 5).astype(np.float32)
+    # lr=0 inner update: the step is pure gossip, so the oracle needs no
+    # optimizer modeling
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    params = {"w": bf.worker_values(lambda r: x[r])}
+    s = opt.init(params)
+    p, s = opt.step(params, s, {"w": jnp.zeros_like(params["w"])})
+    metrics.flush()  # fold the deferred drain now
+
+    y = w.T @ x  # combine: y_j = sum_i W[i, j] x_i
+    per_worker = np.linalg.norm(x - y, axis=1)
+    snap = metrics.snapshot()
+    got_mean = snap["bluefog.gossip.disagreement"]["value"]
+    got_max = snap["bluefog.gossip.disagreement.max"]["value"]
+    np.testing.assert_allclose(got_mean, per_worker.mean(), rtol=1e-5)
+    np.testing.assert_allclose(got_max, per_worker.max(), rtol=1e-5)
+    # the gossip output itself matches the oracle combine
+    np.testing.assert_allclose(np.asarray(p["w"]), y, rtol=1e-5, atol=1e-6)
+    # param-norm slot: rms over workers of ||x_i||
+    np.testing.assert_allclose(
+        snap["bluefog.gossip.param_norm"]["value"],
+        np.linalg.norm(x, axis=1).mean(), rtol=1e-5,
+    )
+
+
+def test_quant_err_and_ef_residual_populate(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
+    c = np.random.RandomState(0).randn(SIZE, 600).astype(np.float32)
+    for wire, slot in (("int8", "quant_err"), ("int8_ef", "ef_residual")):
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+        opt.compression = wire
+        params = {"w": bf.worker_values(lambda r: c[r])}
+        s = opt.init(params)
+        opt.step(params, s, {"w": jnp.zeros_like(params["w"])})
+        metrics.flush()
+        val = metrics.snapshot()[f"bluefog.gossip.{slot}"]["value"]
+        assert val > 0.0, (wire, slot)
+    # int8_ef: CHOCO identity — this step's quantization error IS the
+    # new residual
+    snap = metrics.snapshot()
+    assert (
+        snap["bluefog.gossip.quant_err"]["value"]
+        == snap["bluefog.gossip.ef_residual"]["value"]
+    )
+
+
+def test_wire_bytes_and_rounds_accounting(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "5")
+    c = np.random.RandomState(0).randn(SIZE, 256).astype(np.float32)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    s = opt.init(params)
+    p = params
+    for _ in range(3):
+        p, s = opt.step(p, s, {"w": jnp.zeros_like(p["w"])})
+    snap = metrics.snapshot()
+    # Exp2(8) lowers to 3 rounds; f32 payload of 256 elems re-shipped
+    # per round
+    assert snap["bluefog.gossip.rounds"]["value"] == 3.0
+    assert snap["bluefog.wire_bytes"]["value"] == 3 * (3 * 256 * 4)
+    assert snap["bluefog.comm_steps"]["value"] == 3.0
+
+
+def test_plan_wire_bytes_helper():
+    from bluefog_tpu.collective.plan import plan_from_topology
+
+    plan = plan_from_topology(tu.ExponentialTwoGraph(SIZE), weighted=True)
+    assert plan.wire_bytes(1024, 4) == len(plan.rounds) * 1024 * 4
+    # int8: 1 byte/elem + one f32 scale per 512-element chunk
+    assert plan.wire_bytes(1024, 4, wire="int8") == len(plan.rounds) * (
+        1024 + 4 * 2
+    )
+    assert plan.wire_bytes(1024, 4, wire="bf16") == len(plan.rounds) * 2048
+
+
+def test_plan_cache_and_recompile_counters():
+    from bluefog_tpu.collective import compiler
+
+    compiler.clear_compile_cache()
+    before = metrics.counter("bluefog.plan_cache.misses").value
+    edges = tuple((i, (i + 1) % SIZE) for i in range(SIZE))
+    compiler.compile_edges(edges, SIZE)
+    compiler.compile_edges(edges, SIZE)
+    assert metrics.counter("bluefog.plan_cache.misses").value == before + 1
+    assert metrics.counter("bluefog.plan_cache.hits").value >= 1
+    # eager dispatch: first build counts as a recompile, repeats do not
+    x = bf.worker_values(np.float32(1))
+    bf.neighbor_allreduce(x)
+    r0 = metrics.counter("bluefog.recompiles").value
+    bf.neighbor_allreduce(x)
+    assert metrics.counter("bluefog.recompiles").value == r0
+
+
+# -- the bitwise on/off pin ---------------------------------------------------
+
+
+FACTORIES = {
+    "cta": bf.DistributedNeighborAllreduceOptimizer,
+    "atc": lambda tx: bf.DistributedAdaptThenCombineOptimizer(
+        tx, bf.CommunicationType.neighbor_allreduce
+    ),
+}
+
+
+def _run_steps(order, wire, enabled, c, monkeypatch, fused):
+    monkeypatch.setenv("BLUEFOG_METRICS", "1" if enabled else "0")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "2")
+    opt = FACTORIES[order](optax.sgd(0.1, momentum=0.9))
+    opt.compression = wire
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    s = opt.init(params)
+    p = params
+    if fused:
+        cvals = bf.worker_values(lambda r: c[r])
+
+        def loss_fn(pp, cv):
+            return 0.5 * jnp.sum((pp["w"] - cv) ** 2)
+
+        train_step = opt.make_train_step(loss_fn)
+        for _ in range(3):
+            p, s, _loss = train_step(p, s, cvals)
+    else:
+        for _ in range(3):
+            p, s = opt.step(p, s, {"w": p["w"] - jnp.asarray(c)})
+    return p, s
+
+
+@pytest.mark.parametrize("order", ["cta", "atc"])
+@pytest.mark.parametrize("wire", [None, "int8", "int8_ef"])
+def test_metrics_on_off_bitwise_identical(order, wire, monkeypatch):
+    """THE pin: enabling metrics recompiles the step with extra outputs
+    but must not perturb params or optimizer state by a single bit, for
+    ATC/CTA x fp32/int8/int8_ef."""
+    c = np.random.RandomState(1).randn(SIZE, 700).astype(np.float32)
+    p_off, s_off = _run_steps(order, wire, False, c, monkeypatch, False)
+    p_on, s_on = _run_steps(order, wire, True, c, monkeypatch, False)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((p_off, s_off)),
+        jax.tree_util.tree_leaves((p_on, s_on)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metrics_on_off_bitwise_identical_fused(monkeypatch):
+    c = np.random.RandomState(2).randn(SIZE, 300).astype(np.float32)
+    p_off, s_off = _run_steps("cta", None, False, c, monkeypatch, True)
+    p_on, s_on = _run_steps("cta", None, True, c, monkeypatch, True)
+    for a, b in zip(
+        jax.tree_util.tree_leaves((p_off, s_off)),
+        jax.tree_util.tree_leaves((p_on, s_on)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metrics_drain_interval(monkeypatch):
+    """No registry update before the interval elapses; the periodic
+    path (swap at one boundary, fold at the next — no explicit flush)
+    populates it after two intervals."""
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "3")
+    c = np.random.RandomState(3).randn(SIZE, 8).astype(np.float32)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    s = opt.init(params)
+    p = params
+    for i in range(2):
+        p, s = opt.step(p, s, {"w": jnp.zeros_like(p["w"])})
+    assert "bluefog.gossip.disagreement" not in metrics.snapshot()
+    for i in range(4):  # steps 3..6: swap at 3, deferred fold at 6
+        p, s = opt.step(p, s, {"w": jnp.zeros_like(p["w"])})
+    snap = metrics.snapshot()
+    assert snap["bluefog.gossip.disagreement"]["value"] > 0
+    # the drained window really covered `interval` communicating steps
+    assert snap["bluefog.comm_steps"]["value"] == 6.0
+
+
+def test_jsonl_auto_export_on_drain(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
+    path = str(tmp_path / "auto.jsonl")
+    monkeypatch.setenv("BLUEFOG_METRICS_FILE", path)
+    c = np.random.RandomState(4).randn(SIZE, 8).astype(np.float32)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    s = opt.init(params)
+    p = params
+    for _ in range(3):
+        p, s = opt.step(p, s, {"w": jnp.zeros_like(p["w"])})
+    # drains fold one interval late (async copy): 3 steps at interval 1
+    # = 2 folded time-series points so far
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert len(lines) == 2, lines
+    assert all(
+        "bluefog.gossip.disagreement" in l["metrics"] for l in lines
+    )
+    bf.metrics_export()  # flushes the tail and appends a final line
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert len(lines) == 3
+
+
+def test_watchdog_stall_counts_and_marks_timeline(tmp_path):
+    import time
+
+    from bluefog_tpu import watchdog
+
+    path = str(tmp_path / "stall_trace.json")
+    assert bf.timeline_init(path)
+    watchdog.set_stall_timeout(0.1)
+    before = metrics.counter("bluefog.stalls").value
+    try:
+        with watchdog.watch("metrics-test-op"):
+            time.sleep(0.5)
+    finally:
+        watchdog.set_stall_timeout(60)
+        assert bf.timeline_shutdown()
+    assert metrics.counter("bluefog.stalls").value == before + 1
+    events = json.load(open(path))
+    stalls = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("cat") == "STALL"
+    ]
+    assert stalls and "metrics-test-op" in stalls[0]["name"]

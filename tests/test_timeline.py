@@ -73,6 +73,47 @@ def test_timeline_env_activation(tmp_path, monkeypatch, cpu_devices):
     assert any(e.get("cat") == "ENQUEUE" for e in events)
 
 
+def test_timeline_counter_events_valid_chrome_trace(tmp_path, monkeypatch):
+    """Counter (ph=C) and instant (ph=i) records — the metrics exporter's
+    timeline tier — interleave with op spans and the file still loads as
+    a valid Chrome trace."""
+    from bluefog_tpu import metrics
+
+    path = str(tmp_path / "counters.json")
+    assert bf.timeline_init(path)
+    x = bf.worker_values(lambda r: np.float32(r))
+    # drive a real device-tier drain so counters flow through the
+    # registry exporter, not just the raw record call
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
+    import optax
+
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    params = {"w": x}
+    state = opt.init(params)
+    opt.step(params, state, {"w": jnp.zeros_like(x)})
+    bf.metrics_export()  # flush the deferred drain onto the timeline
+    bf.timeline_record_counter("bluefog.custom", 1.25)
+    bf.timeline_record_instant("marker")
+    assert bf.timeline_shutdown()
+
+    events = json.load(open(path))  # valid JSON array == valid trace
+    assert isinstance(events, list)
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters, events[:5]
+    for e in counters:
+        # chrome requires counter values under args
+        assert "value" in e["args"], e
+        assert isinstance(e["ts"], int)
+    names = {e["name"] for e in counters}
+    assert "bluefog.custom" in names
+    assert "bluefog.gossip.disagreement" in names, names
+    instants = [e for e in events if e.get("ph") == "i"]
+    assert instants and instants[0]["s"] == "p"
+    # spans and counters coexist in one file
+    assert any(e.get("cat") == "ENQUEUE" for e in events)
+
+
 def test_double_init_rejected(tmp_path):
     path = str(tmp_path / "t.json")
     assert bf.timeline_init(path)

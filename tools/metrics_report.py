@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Summarize a BlueFog JSONL metrics file (``BLUEFOG_METRICS_FILE`` /
+``bf.metrics_export``).
+
+Each input line is one registry snapshot
+(``{"ts": ..., "metrics": {name: {"type": ..., "value"/...}}}``,
+appended at every device-buffer drain). The report gives, per series,
+the min / max / last observed value over the run plus the snapshot
+count — the at-a-glance answer to "did consensus drift grow", "did the
+EF residual blow up", "how many stalls" — without opening a dashboard.
+
+Usage::
+
+    python tools/metrics_report.py run.jsonl            # human table
+    python tools/metrics_report.py run.jsonl --json     # machine-readable
+
+Exit status is 0 on a parseable file (even an empty one reports
+cleanly), 2 on unreadable input.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _series_value(desc: dict):
+    """Scalar view of one snapshot entry: counters/gauges their value,
+    histograms their last observation."""
+    if "value" in desc:
+        return desc["value"]
+    return desc.get("last")
+
+
+def summarize(lines):
+    """Fold parsed snapshot objects into
+    ``{series: {min, max, last, samples}}`` + top-level stall count."""
+    series = {}
+    skipped = 0
+    for obj in lines:
+        # a JSONL line can parse to a non-object (truncated/interleaved
+        # writes); treat it like any other unusable line
+        metrics = obj.get("metrics") if isinstance(obj, dict) else None
+        if not isinstance(metrics, dict):
+            skipped += 1
+            continue
+        for name, desc in metrics.items():
+            v = _series_value(desc)
+            if v is None:
+                continue
+            cur = series.setdefault(
+                name,
+                {"min": v, "max": v, "last": v, "samples": 0,
+                 "type": desc.get("type", "?")},
+            )
+            cur["min"] = min(cur["min"], v)
+            cur["max"] = max(cur["max"], v)
+            cur["last"] = v
+            cur["samples"] += 1
+    stalls = series.get("bluefog.stalls", {}).get("last", 0)
+    return {
+        "snapshots": len(lines) - skipped,
+        "skipped_lines": skipped,
+        "stall_count": stalls,
+        "series": series,
+    }
+
+
+def load(path: str):
+    out = []
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                out.append(json.loads(raw))
+            except json.JSONDecodeError:
+                print(
+                    f"warning: line {ln} is not JSON, skipping",
+                    file=sys.stderr,
+                )
+                out.append({})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="JSONL metrics file")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as one JSON object instead of a table",
+    )
+    args = ap.parse_args(argv)
+    try:
+        lines = load(args.path)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    report = summarize(lines)
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    print(f"snapshots: {report['snapshots']}"
+          + (f" ({report['skipped_lines']} skipped)"
+             if report["skipped_lines"] else ""))
+    print(f"stalls:    {report['stall_count']:g}")
+    if not report["series"]:
+        print("no series recorded")
+        return 0
+    width = max(len(n) for n in report["series"])
+    print(f"{'series'.ljust(width)}  {'min':>12} {'max':>12} {'last':>12}")
+    for name in sorted(report["series"]):
+        s = report["series"][name]
+        print(
+            f"{name.ljust(width)}  {s['min']:>12.6g} {s['max']:>12.6g} "
+            f"{s['last']:>12.6g}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `metrics_report.py run.jsonl | head` closing the pipe early is
+        # normal CLI usage, not an error
+        sys.exit(0)
