@@ -69,6 +69,70 @@ _PEAK_BF16 = {
 _FLOPS_PER_IMG_FWD_BWD = 3 * 8.2e9
 
 
+def _provenance() -> dict:
+    """Round-over-round bench deltas are only attributable when every
+    evidence artifact records WHAT produced it: jax/jaxlib versions,
+    platform, CPU model, timing method, and the git SHA. Emitted as a
+    standalone ``{"metric": "provenance"}`` line by every BENCH_MODE, so
+    committed ``BENCH_*``/``*_EVIDENCE`` files carry it."""
+    import platform as _platform
+    import subprocess
+
+    import jax
+    import jaxlib
+
+    cpu_model = ""
+    try:
+        fields = {}
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    fields.setdefault(k.strip(), v.strip())
+                if line.strip() == "":
+                    break  # first processor block is enough
+        cpu_model = fields.get("model name", "")
+        if cpu_model in ("", "unknown"):
+            # virtualized hosts often blank the model name; the numeric
+            # family/model ids still identify the microarchitecture
+            cpu_model = " ".join(
+                filter(None, (
+                    fields.get("vendor_id", ""),
+                    f"family={fields.get('cpu family', '?')}",
+                    f"model={fields.get('model', '?')}",
+                ))
+            )
+    except OSError:
+        cpu_model = _platform.processor() or _platform.machine()
+    try:
+        sha = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except (OSError, subprocess.SubprocessError):
+        # TimeoutExpired included: a hung git (stale lock, slow NFS)
+        # must degrade to sha="unknown", not kill the whole bench
+        sha = "unknown"
+    return {
+        "metric": "provenance",
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "python": sys.version.split()[0],
+        # requested platform only — resolving the actual backend here
+        # would initialize it before the mode's own device setup
+        "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+        "platform_node": _platform.platform(),
+        "cpu_model": cpu_model,
+        "timing_method": (
+            "time.perf_counter, timed_differenced windows "
+            "(bluefog_tpu/timing.py); best-of-N with spread disclosed"
+        ),
+        "git_sha": sha,
+        "bench_mode": os.environ.get("BENCH_MODE", "all"),
+    }
+
+
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "")
     for key, val in _PEAK_BF16.items():
@@ -1161,6 +1225,131 @@ def run_metrics() -> int:
     return 0
 
 
+def run_elastic() -> int:
+    """Elastic-gossip evidence (``BENCH_MODE=elastic``): an 8-worker CPU
+    mesh with a rank killed mid-training through the deterministic chaos
+    layer. Emits steps-to-detect, steps-to-repair, the post-repair
+    consensus distance against the numpy survivor-oracle, and the
+    plan-cache live-set accounting proving no stale CommPlan dispatched
+    after the membership change. ``BENCH_ASSERT=1`` (default) enforces
+    the acceptance bounds. See docs/elastic.md."""
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_ELASTIC_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+
+    devices = jax.devices()
+    n = min(len(devices), int(os.environ.get("BENCH_ELASTIC_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_ELASTIC_DIM", "4096"))
+    kill_step = int(os.environ.get("BENCH_ELASTIC_KILL_STEP", "5"))
+    grad_steps = int(os.environ.get("BENCH_ELASTIC_GRAD_STEPS", "12"))
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", "48"))
+    kill_rank = n // 2
+    lr = np.float32(0.05)
+
+    bf.init(devices=devices[:n])
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+    ctx = bf.get_context()
+
+    session = bf.elastic.start(policy="average")
+    session.inject("kill", rank=kill_rank, step=kill_step)
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(float(lr)))
+    guard = bf.elastic.guard(opt)
+
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(n, dim).astype(np.float32)
+    grads = [
+        rng.randn(n, dim).astype(np.float32) * 0.1 for _ in range(grad_steps)
+    ]
+    zeros = np.zeros((n, dim), np.float32)
+    params = {"w": bf.worker_values(lambda r: x0[r])}
+    state = opt.init(params)
+    at_repair = None
+    t0 = time.perf_counter()
+    for t in range(steps):
+        g = grads[t] if t < grad_steps else zeros
+        if t == kill_step:
+            at_repair = np.asarray(params["w"])
+        params, state = guard.step(
+            params, state, {"w": bf.worker_values(lambda r: g[r])}
+        )
+    wall_s = time.perf_counter() - t0
+
+    rec = session.repairs[0]
+    live = list(session.membership.live_ranks())
+    final = np.asarray(params["w"])
+
+    # survivor-consensus oracle: mean of survivors at repair plus the
+    # post-repair gradient drift (the doubly stochastic repaired mix
+    # preserves the survivor mean exactly)
+    target = at_repair[live].mean(axis=0)
+    for t in range(kill_step, grad_steps):
+        target = target - lr * grads[t][live].mean(axis=0)
+    consensus_dist = float(np.abs(final[live] - target).max())
+    spread = float(np.abs(final[live] - final[live].mean(axis=0)).max())
+
+    # live-set-aware plan cache: every static plan compiled after the
+    # session opened carries a live token; repair added a new entry
+    plan_keys = [
+        k for k in ctx.op_cache if isinstance(k, tuple)
+        and k and k[0] == "static_plan"
+    ]
+    tokened = [k for k in plan_keys if k[-1] is not None]
+
+    detect = max(rec.steps_to_detect.values())
+    lines = [
+        {
+            "metric": "elastic_repair",
+            "workers": n,
+            "kill_rank": kill_rank,
+            "kill_step": kill_step,
+            "repair_step": rec.step,
+            "steps_to_detect": detect,
+            "steps_to_repair": rec.steps_to_repair,
+            "policy": rec.policy,
+            "dead": list(rec.dead),
+            "live_count": len(live),
+            "topo_version_after": rec.topo_version,
+            "wall_s_total": round(wall_s, 3),
+        },
+        {
+            "metric": "elastic_consensus",
+            "steps_after_repair": steps - kill_step,
+            "post_repair_consensus_distance": consensus_dist,
+            "survivor_spread": spread,
+            "oracle": "numpy survivor mean + gradient drift",
+        },
+        {
+            "metric": "elastic_plan_cache",
+            "static_plan_cache_entries": len(plan_keys),
+            "entries_with_live_token": len(tokened),
+            "stale_commplan_dispatches": session.stale_dispatches,
+        },
+    ]
+    for line in lines:
+        print(json.dumps(line))
+    bf.elastic.stop()
+
+    if os.environ.get("BENCH_ASSERT", "1") == "1":
+        assert detect <= 1, f"detection took {detect} steps"
+        assert rec.steps_to_repair == 0, rec
+        assert session.stale_dispatches == 0
+        assert consensus_dist < 1e-3, consensus_dist
+        assert tokened, "static-plan cache keys carry no live token"
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -1354,8 +1543,8 @@ def run_all() -> int:
     out the headline), headline last for tail-reading drivers."""
     import subprocess
 
-    for mode in ("scaling", "plan", "overlap", "metrics", "gossip",
-                 "flash", "transformer"):
+    for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
+                 "gossip", "flash", "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -1388,8 +1577,11 @@ def run_all() -> int:
 
 def main() -> int:
     mode = os.environ.get("BENCH_MODE", "")
+    print(json.dumps(_provenance()), flush=True)
     if mode == "scaling":
         return run_scaling()
+    if mode == "elastic":
+        return run_elastic()
     if mode == "plan":
         return run_plan()
     if mode == "overlap":

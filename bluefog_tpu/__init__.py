@@ -75,6 +75,7 @@ from bluefog_tpu.utility import (
     allreduce_parameters,
 )
 from bluefog_tpu import checkpoint
+from bluefog_tpu import elastic
 from bluefog_tpu import ops
 from bluefog_tpu.timeline import (
     timeline_init,
@@ -327,6 +328,7 @@ __all__ = [
     "timeline_record_instant",
     "timeline_record_counter",
     "timeline_context",
+    "elastic",
     "metrics",
     "metrics_snapshot",
     "metrics_export",

@@ -24,6 +24,7 @@ onto any mesh of the same worker count); orbax handles atomicity
 (tmp-dir + rename) and async-capable IO.
 """
 
+import hashlib
 import os
 from typing import Optional, Tuple
 
@@ -33,8 +34,85 @@ import jax
 
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import windows as win_mod
+from bluefog_tpu.logging_util import logger
 
-__all__ = ["save", "restore", "latest_step"]
+__all__ = ["save", "restore", "latest_step", "topology_digest"]
+
+
+def topology_digest(topo) -> Optional[str]:
+    """Stable fingerprint of a weighted topology (sha1 of the combine
+    matrix bytes). Version counters are process-local and meaningless
+    across restarts; the digest is what mismatch detection compares."""
+    import networkx as nx
+
+    if topo is None:
+        return None
+    return hashlib.sha1(
+        np.ascontiguousarray(nx.to_numpy_array(topo)).tobytes()
+    ).hexdigest()
+
+
+def _graph_info() -> Optional[dict]:
+    """The graph-shape block ``save`` records: world size, topology
+    version + digest, and the elastic live set (everyone, without an
+    elastic session). None when bluefog is not initialized."""
+    if not ctx_mod.is_initialized():
+        return None
+    ctx = ctx_mod.get_context()
+    m = ctx.elastic_membership
+    live = list(m.live_ranks()) if m is not None else list(range(ctx.size))
+    return {
+        "world_size": int(ctx.size),
+        "topo_version": int(ctx.topo_version),
+        "topo_digest": topology_digest(ctx.load_topology()),
+        "live_ranks": live,
+    }
+
+
+def _check_graph_info(info: dict, optimizer) -> None:
+    """Refuse (or elastically repair) a restore whose graph shape does
+    not match the live context — silently loading state shaped for a
+    different graph is how runs diverge unexplained."""
+    from bluefog_tpu import elastic as elastic_mod
+
+    ctx = ctx_mod.get_context()
+    saved_size = int(info["world_size"])
+    if saved_size != ctx.size:
+        raise ValueError(
+            f"checkpoint was saved on a {saved_size}-worker mesh but the "
+            f"current mesh has {ctx.size} workers; re-launch with the "
+            f"saved world size (bfrun-tpu -np {saved_size}) or re-shard "
+            "the checkpoint explicitly"
+        )
+    saved_live = tuple(int(r) for r in info.get("live_ranks", []))
+    cur_m = ctx.elastic_membership
+    cur_live = (
+        cur_m.live_ranks() if cur_m is not None else tuple(range(ctx.size))
+    )
+    saved_digest = info.get("topo_digest")
+    cur_digest = topology_digest(ctx.load_topology())
+    if saved_live == cur_live and saved_digest == cur_digest:
+        return
+    session = elastic_mod.active_session()
+    if session is not None and saved_live != cur_live:
+        # the elastic path: adopt the checkpoint's live set and repair
+        # the topology to match instead of refusing
+        logger.warning(
+            "checkpoint live set %s differs from current %s; repairing "
+            "topology to the saved membership", list(saved_live),
+            list(cur_live),
+        )
+        session.adopt_live_set(saved_live, optimizer)
+        return
+    raise ValueError(
+        "checkpoint topology does not match the live context "
+        f"(saved topology v{info.get('topo_version')} digest "
+        f"{saved_digest!r}, live {list(saved_live)}; current digest "
+        f"{cur_digest!r}, live {list(cur_live)}): restoring would "
+        "silently load state shaped for a different graph. Install the "
+        "matching topology with bf.set_topology(), or start an elastic "
+        "session (bf.elastic.start()) to repair to the saved live set."
+    )
 
 
 def _checkpointer():
@@ -81,6 +159,11 @@ def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
         "params": _to_host(params),
         "opt_state": _to_host(opt_state),
     }
+    graph_info = _graph_info()
+    if graph_info is not None:
+        # recorded as a repr'd literal: orbax round-trips nested dicts of
+        # mixed scalars/lists as arrays; a string survives exactly
+        payload["graph_info"] = repr(graph_info)
     if optimizer is not None:
         counter = getattr(optimizer, "_step_count", None)
         if counter is not None:
@@ -133,6 +216,11 @@ def restore(path: str, step: Optional[int] = None,
             raise FileNotFoundError(f"no checkpoints under {path}")
     target = os.path.join(os.path.abspath(path), str(int(step)))
     payload = _checkpointer().restore(target)
+    graph_info = payload.get("graph_info")
+    if graph_info is not None and ctx_mod.is_initialized():
+        import ast
+
+        _check_graph_info(ast.literal_eval(str(graph_info)), optimizer)
     if optimizer is not None:
         wstate = payload.get("window")
         from bluefog_tpu.optimizers import _WindowOptimizer

@@ -215,7 +215,14 @@ def _static_plan(ctx) -> CommPlan:
     topo = ctx.load_topology()
     assert topo is not None, "no topology set; call bf.init()/bf.set_topology()"
     method = _plan_method()
-    key = ("static_plan", ctx.topo_version, ctx.is_topo_weighted(), method)
+    # live_token(): the elastic live set (None without an elastic
+    # session). A membership change — even one that reinstalls an
+    # identical-looking graph — gets its own cache slot, so a repair can
+    # never dispatch a plan compiled for the pre-failure live set.
+    key = (
+        "static_plan", ctx.topo_version, ctx.is_topo_weighted(), method,
+        ctx.live_token(),
+    )
     plan = ctx.op_cache.get(key)
     if plan is None:
         plan = plan_from_topology(

@@ -203,6 +203,12 @@ class BluefogContext:
         # reuse an already-seen plan hit the cache instead of recompiling.
         self.op_cache: dict = {}
 
+        # Elastic live-set state (bluefog_tpu.elastic): None until an
+        # ElasticSession installs a Membership. Static-plan cache keys
+        # fold live_token() in, so a membership change can never
+        # dispatch a stale plan.
+        self.elastic_membership = None
+
         if topology_fn is not None:
             topo = topology_fn(self.size)
             assert topo is not None, "topology_fn returned None"
@@ -255,6 +261,16 @@ class BluefogContext:
 
     def is_machine_topo_weighted(self) -> bool:
         return self._machine_topo_weighted
+
+    # -- elastic live set (bluefog_tpu.elastic) ------------------------------
+
+    def live_token(self):
+        """Hashable (epoch, live-rank tuple) identifying the current live
+        set, or None when no elastic session is active (everyone lives).
+        Compiled-plan caches key on this so membership changes invalidate
+        exactly the plans they must."""
+        m = self.elastic_membership
+        return None if m is None else m.token()
 
     # -- neighbor queries (reference basics.py:203-265) ----------------------
 
@@ -314,6 +330,11 @@ def init(
     """
     global _context
     maybe_init_distributed()
+    # An elastic session is bound to one context's membership; a re-init
+    # must not leave it pointing at the torn-down mesh.
+    from bluefog_tpu import elastic as _elastic
+
+    _elastic.stop()
     with _lock:
         _context = BluefogContext(
             topology_fn=topology_fn,
@@ -341,8 +362,11 @@ def shutdown() -> None:
     timeline the user opened with ``timeline_init`` stays open (it is
     theirs to close)."""
     global _context
+    from bluefog_tpu import elastic as _elastic
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
+
+    _elastic.stop()
 
     # Final flush of deferred device drains + the env-configured
     # exporters (JSONL / Prometheus / timeline counters) BEFORE an

@@ -1,0 +1,151 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Deterministic fault injection (chaos layer) for elastic gossip.
+
+Real multi-host failures are irreproducible; tier-1 tests run on a
+single-process virtual CPU mesh where nothing ever actually dies. This
+module closes the gap with a *deterministic chaos plan*: a list of
+(kind, rank, step) faults that the elastic session replays at exact step
+indices, so every failure mode — crash, stall past the liveness
+deadline, degraded link — is a reproducible unit test rather than a
+3 a.m. page.
+
+Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
+
+    kill:rank=3,step=5
+    stall:rank=2,step=10,seconds=120
+    degrade:rank=1,step=4,factor=0.25
+
+- ``kill``     — the rank is dead from ``step`` on (process crash).
+- ``stall``    — the rank blocks for ``seconds`` at ``step``. A stall at
+  or past the liveness deadline (``BLUEFOG_LIVENESS_TIMEOUT``) is
+  condemned exactly like a kill; a shorter one is recorded (counter +
+  timeline marker) and survives — transient slowness must NOT trigger
+  repair.
+- ``degrade``  — from ``step`` on the rank's gossip edges are scaled by
+  ``factor`` (and receiver weights renormalized) at the next repair:
+  the TopoOpt-style "co-optimize around a slow link" response.
+
+Programmatic equivalent: :func:`bluefog_tpu.elastic.inject`.
+"""
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+__all__ = ["Fault", "FaultPlan", "parse_fault_plan", "FAULT_PLAN_ENV"]
+
+FAULT_PLAN_ENV = "BLUEFOG_FAULT_PLAN"
+
+_KINDS = ("kill", "stall", "degrade")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault. ``step`` indexes the elastic session's own
+    monotonic step counter (a dispatch = one step)."""
+
+    kind: str
+    rank: int
+    step: int
+    seconds: float = 0.0  # stall duration (simulated)
+    factor: float = 1.0  # degrade link-quality scale
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"fault kind must be one of {_KINDS}, got {self.kind!r}"
+            )
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+        if self.kind == "stall" and self.seconds < 0:
+            raise ValueError(
+                f"stall seconds must be >= 0, got {self.seconds}"
+            )
+        if self.kind == "degrade" and not 0.0 < self.factor <= 1.0:
+            raise ValueError(
+                f"degrade factor must be in (0, 1], got {self.factor}"
+            )
+
+
+def _parse_clause(clause: str) -> Fault:
+    head, _, body = clause.partition(":")
+    kind = head.strip().lower()
+    fields = {}
+    if body.strip():
+        for pair in body.split(","):
+            if "=" not in pair:
+                raise ValueError(
+                    f"fault clause field {pair!r} is not key=value "
+                    f"(in {clause!r})"
+                )
+            k, v = pair.split("=", 1)
+            fields[k.strip().lower()] = v.strip()
+    unknown = set(fields) - {"rank", "step", "seconds", "factor"}
+    if unknown:
+        raise ValueError(
+            f"unknown fault fields {sorted(unknown)} in {clause!r}; "
+            "accepted: rank, step, seconds, factor"
+        )
+    for required in ("rank", "step"):
+        if required not in fields:
+            raise ValueError(
+                f"fault clause {clause!r} is missing {required}="
+            )
+    return Fault(
+        kind=kind,
+        rank=int(fields["rank"]),
+        step=int(fields["step"]),
+        seconds=float(fields.get("seconds", 0.0)),
+        factor=float(fields.get("factor", 1.0)),
+    )
+
+
+def parse_fault_plan(text: Optional[str]) -> "FaultPlan":
+    """Parse the ``BLUEFOG_FAULT_PLAN`` grammar into a :class:`FaultPlan`
+    (empty plan for empty/None input)."""
+    faults: List[Fault] = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if clause:
+            faults.append(_parse_clause(clause))
+    return FaultPlan(faults)
+
+
+class FaultPlan:
+    """An ordered, step-indexed set of scheduled faults."""
+
+    def __init__(self, faults=()):
+        self._faults: List[Fault] = sorted(
+            faults, key=lambda f: (f.step, f.rank)
+        )
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultPlan":
+        env = os.environ if env is None else env
+        return parse_fault_plan(env.get(FAULT_PLAN_ENV))
+
+    @property
+    def faults(self) -> Tuple[Fault, ...]:
+        return tuple(self._faults)
+
+    def __len__(self):
+        return len(self._faults)
+
+    def __bool__(self):
+        return bool(self._faults)
+
+    def add(self, fault: Fault) -> None:
+        self._faults.append(fault)
+        self._faults.sort(key=lambda f: (f.step, f.rank))
+
+    def due(self, step: int) -> Tuple[Fault, ...]:
+        """Faults scheduled at exactly ``step``."""
+        return tuple(f for f in self._faults if f.step == int(step))
+
+    def validate(self, world_size: int) -> None:
+        for f in self._faults:
+            if not 0 <= f.rank < world_size:
+                raise ValueError(
+                    f"fault plan names rank {f.rank} but the mesh has "
+                    f"{world_size} workers"
+                )

@@ -31,7 +31,8 @@ import os
 import shlex
 import subprocess
 import sys
-from typing import Dict, List, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Sequence, Tuple
 
 from bluefog_tpu.run import network_util
 from bluefog_tpu.platforms import (
@@ -39,7 +40,15 @@ from bluefog_tpu.platforms import (
     with_exact_cpu_device_count,
 )
 
-__all__ = ["parse_args", "build_child_env", "build_host_commands", "main"]
+__all__ = [
+    "parse_args",
+    "build_child_env",
+    "build_host_commands",
+    "resolve_max_restarts",
+    "backoff_seconds",
+    "run_with_restarts",
+    "main",
+]
 
 DEFAULT_COORDINATOR_PORT = 9781
 
@@ -110,6 +119,15 @@ def parse_args(argv: Sequence[str] = None) -> argparse.Namespace:
         "used; its absolute path may not exist on other machines.",
     )
     parser.add_argument(
+        "--max-restarts", action="store", dest="max_restarts", type=int,
+        default=None,
+        help="Restart a worker process that exits nonzero up to this many "
+        "times, with exponential backoff (default from "
+        "BLUEFOG_MAX_RESTARTS, else 0 = fail fast). The elastic subsystem "
+        "(docs/elastic.md) handles in-run repair; this handles the process "
+        "layer.",
+    )
+    parser.add_argument(
         "--extra-env", action="append", dest="extra_env", default=[],
         metavar="KEY=VALUE",
         help="Extra environment variable for the launched processes "
@@ -174,6 +192,59 @@ def build_child_env(
         env["BLUEFOG_PROCESS_ID"] = str(args.process_id or 0)
     env.update(_parse_extra_env(args.extra_env))
     return env
+
+
+def resolve_max_restarts(args, env: Dict[str, str] = None) -> int:
+    """The effective restart budget (pure; unit tested): the CLI flag
+    wins, then ``BLUEFOG_MAX_RESTARTS``, then 0 (fail fast). Negative
+    values are rejected — an unbounded restart loop hides a crash-looping
+    job from its operator."""
+    env = os.environ if env is None else env
+    value = getattr(args, "max_restarts", None)
+    if value is None:
+        raw = env.get("BLUEFOG_MAX_RESTARTS", "0")
+        try:
+            value = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"BLUEFOG_MAX_RESTARTS must be an integer, got {raw!r}"
+            )
+    if value < 0:
+        raise ValueError(f"max restarts must be >= 0, got {value}")
+    return value
+
+
+def backoff_seconds(attempt: int, base: float = 1.0, cap: float = 30.0) -> float:
+    """Exponential backoff before restart ``attempt`` (0-based): ``base *
+    2**attempt`` capped at ``cap`` (pure; unit tested)."""
+    assert attempt >= 0
+    return min(float(cap), float(base) * (2.0 ** attempt))
+
+
+def run_with_restarts(
+    start: Callable[[], int],
+    max_restarts: int,
+    sleep: Callable[[float], None] = time.sleep,
+    base: float = 1.0,
+    log=None,
+) -> int:
+    """Run ``start()`` (returning an exit code), restarting on nonzero
+    exit up to ``max_restarts`` times with exponential backoff. Returns
+    the final exit code. Pure given injected ``start``/``sleep`` — the
+    unit-testable core of ``--max-restarts``."""
+    attempt = 0
+    while True:
+        rc = start()
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        delay = backoff_seconds(attempt, base=base)
+        if log is not None:
+            log(
+                f"[bfrun-tpu] worker exited with {rc}; restart "
+                f"{attempt + 1}/{max_restarts} in {delay:g}s"
+            )
+        sleep(delay)
+        attempt += 1
 
 
 def _command_argv(
@@ -281,25 +352,67 @@ def main(argv: Sequence[str] = None) -> int:
             if args.verbose:
                 for host, argv_ in commands:
                     print(f"[bfrun-tpu] {host}: {' '.join(argv_)}")
-            procs = [
-                subprocess.Popen(argv_) for _host, argv_ in commands
-            ]
-            rc = 0
-            for (host, _), proc in zip(commands, procs):
-                host_rc = proc.wait()
-                if host_rc != 0 and rc == 0:
-                    rc = host_rc
-                    print(
-                        f"[bfrun-tpu] process on {host} exited with "
-                        f"{host_rc}",
-                        file=sys.stderr,
-                    )
-            return rc
+            max_restarts = resolve_max_restarts(args)
+
+            def launch_pod() -> int:
+                # jax.distributed is a static world: one host dying tears
+                # down the coordinator, so the restart unit is the whole
+                # pod launch (in-run rank survival is the elastic
+                # subsystem's job, docs/elastic.md). POLL rather than
+                # wait sequentially: a dead host leaves the survivors'
+                # ranks blocked in collectives forever, so waiting on a
+                # hung survivor would mean the failure is never observed
+                # — on the first nonzero exit the remaining processes
+                # are terminated so a relaunch can rebind the
+                # coordinator port.
+                procs = [
+                    subprocess.Popen(argv_) for _host, argv_ in commands
+                ]
+                rc = 0
+                try:
+                    while any(p.poll() is None for p in procs):
+                        for (host, _), proc in zip(commands, procs):
+                            code = proc.poll()
+                            if code is not None and code != 0:
+                                print(
+                                    f"[bfrun-tpu] process on {host} "
+                                    f"exited with {code}; terminating "
+                                    "the pod", file=sys.stderr,
+                                )
+                                return code
+                        time.sleep(0.5)
+                    for proc in procs:
+                        if proc.returncode != 0 and rc == 0:
+                            rc = proc.returncode
+                    return rc
+                finally:
+                    for proc in procs:
+                        if proc.poll() is None:
+                            proc.terminate()
+                    for proc in procs:
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            proc.wait()
+
+            return run_with_restarts(
+                launch_pod, max_restarts,
+                log=lambda msg: print(msg, file=sys.stderr),
+            )
 
     env = build_child_env(args, base_env=dict(os.environ))
     argv_ = _command_argv(args.command)
+    max_restarts = resolve_max_restarts(args)
     if args.verbose:
         print(f"[bfrun-tpu] exec: {' '.join(argv_)}")
+    if max_restarts > 0:
+        # exec would forfeit the supervisor; keep a parent to restart from
+        return run_with_restarts(
+            lambda: subprocess.run(argv_, env=env).returncode,
+            max_restarts,
+            log=lambda msg: print(msg, file=sys.stderr),
+        )
     os.execvpe(argv_[0], argv_, env)
     raise AssertionError("unreachable")  # pragma: no cover
 

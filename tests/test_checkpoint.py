@@ -279,3 +279,108 @@ def test_num_steps_per_communication_resume_exact(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(p_ref["w"]), np.asarray(p2["w"])
         )
+
+
+# -- graph-shape guard (elastic integration) ----------------------------------
+
+
+def test_checkpoint_records_topology_version_and_world_size(tmp_path):
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 1, params, {})
+    import ast
+
+    payload = ckpt._checkpointer().restore(
+        str(tmp_path / "1")
+    )
+    info = ast.literal_eval(str(payload["graph_info"]))
+    ctx = bf.get_context()
+    assert info["world_size"] == SIZE
+    assert info["topo_version"] == ctx.topo_version
+    assert info["topo_digest"] == ckpt.topology_digest(ctx.load_topology())
+    assert info["live_ranks"] == list(range(SIZE))
+
+
+def test_restore_world_size_mismatch_raises(tmp_path, cpu_devices):
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 1, params, {})
+    bf.init(devices=cpu_devices[:4])
+    with pytest.raises(ValueError, match="8-worker mesh.*4 workers"):
+        ckpt.restore(str(tmp_path))
+
+
+def test_restore_topology_mismatch_raises_clear_message(tmp_path):
+    """Restoring window/plan state shaped for a different graph must be
+    an explicit refusal, not a silent load."""
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 1, params, {})
+    bf.set_topology(tu.RingGraph(SIZE))
+    with pytest.raises(ValueError, match="set_topology|elastic"):
+        ckpt.restore(str(tmp_path))
+    # reinstalling the matching topology unblocks the restore
+    bf.set_topology(tu.ExponentialGraph(SIZE))
+    step, p, s = ckpt.restore(str(tmp_path))
+    assert step == 1
+
+
+def test_restore_live_set_mismatch_repairs_under_elastic(tmp_path):
+    """With an elastic session active, a checkpoint recorded under a
+    reduced live set repairs the topology instead of refusing."""
+    session = bf.elastic.start()
+    session.inject("kill", rank=3, step=0)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    session.before_dispatch(opt)  # triggers the repair to 7 survivors
+    assert session.repairs
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 2, params, {})
+    bf.elastic.stop()
+
+    # fresh context: full membership, pristine topology
+    bf.init(devices=bf.get_context().devices)
+    session2 = bf.elastic.start()
+    step, p, s = ckpt.restore(str(tmp_path))
+    assert step == 2
+    assert session2.membership.dead_ranks() == (3,)
+    assert session2.repairs  # topology repaired to the saved live set
+    bf.elastic.stop()
+
+
+def test_restore_pre_graph_info_checkpoint_still_loads(tmp_path):
+    """Checkpoints from before the graph-info block restore untouched
+    (no spurious refusal on legacy data)."""
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    target = ckpt.save(str(tmp_path), 3, params, {})
+    # simulate a legacy checkpoint by stripping the block
+    payload = ckpt._checkpointer().restore(target)
+    payload.pop("graph_info", None)
+    import shutil
+
+    shutil.rmtree(target)
+    ckpt._checkpointer().save(target, payload, force=True)
+    bf.set_topology(tu.RingGraph(SIZE))  # would mismatch, if recorded
+    step, p, s = ckpt.restore(str(tmp_path))
+    assert step == 3
+
+
+def test_restore_superset_live_set_revives_under_elastic(tmp_path):
+    """A checkpoint saved while everyone was alive, restored into a
+    session that has since condemned a rank: the checkpoint's membership
+    is the source of truth, so the rank is revived and the topology
+    repaired back — not silently skipped."""
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 1, params, {})  # full 8-rank live set
+
+    session = bf.elastic.start()
+    session.inject("kill", rank=2, step=0)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    session.before_dispatch(opt)
+    assert session.membership.dead_ranks() == (2,)
+    digest_dead = ckpt.topology_digest(bf.get_context().load_topology())
+
+    step, p, s = ckpt.restore(str(tmp_path))
+    assert step == 1
+    assert session.membership.dead_ranks() == ()
+    # the repaired-back topology matches the full live set again
+    assert ckpt.topology_digest(
+        bf.get_context().load_topology()
+    ) != digest_dead
+    bf.elastic.stop()

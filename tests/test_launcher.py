@@ -364,3 +364,68 @@ def test_interactive_notebook_cells_execute(tmp_path):
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done" in out.stdout
+
+
+# -- restart policy (--max-restarts / BLUEFOG_MAX_RESTARTS) --------------------
+
+
+def test_resolve_max_restarts_precedence():
+    from bluefog_tpu.run.run import resolve_max_restarts
+
+    flag = parse_args(["-np", "2", "--max-restarts", "3", "x.py"])
+    assert resolve_max_restarts(flag, env={"BLUEFOG_MAX_RESTARTS": "9"}) == 3
+    noflag = parse_args(["-np", "2", "x.py"])
+    assert resolve_max_restarts(noflag, env={"BLUEFOG_MAX_RESTARTS": "5"}) == 5
+    assert resolve_max_restarts(noflag, env={}) == 0
+    with pytest.raises(ValueError):
+        resolve_max_restarts(noflag, env={"BLUEFOG_MAX_RESTARTS": "many"})
+    with pytest.raises(ValueError):
+        resolve_max_restarts(
+            parse_args(["-np", "2", "--max-restarts", "-1", "x.py"]), env={}
+        )
+
+
+def test_backoff_is_exponential_and_capped():
+    from bluefog_tpu.run.run import backoff_seconds
+
+    assert [backoff_seconds(a, base=1.0, cap=30.0) for a in range(6)] == [
+        1.0, 2.0, 4.0, 8.0, 16.0, 30.0
+    ]
+    assert backoff_seconds(50, base=1.0, cap=30.0) == 30.0
+
+
+def test_run_with_restarts_retries_then_succeeds():
+    from bluefog_tpu.run.run import run_with_restarts
+
+    codes = iter([1, 1, 0])
+    sleeps, logs = [], []
+    rc = run_with_restarts(
+        lambda: next(codes), max_restarts=5, sleep=sleeps.append,
+        log=logs.append,
+    )
+    assert rc == 0
+    assert sleeps == [1.0, 2.0]  # exponential backoff between attempts
+    assert len(logs) == 2 and "restart 1/5" in logs[0]
+
+
+def test_run_with_restarts_exhausts_budget():
+    from bluefog_tpu.run.run import run_with_restarts
+
+    calls = []
+    rc = run_with_restarts(
+        lambda: calls.append(1) or 7, max_restarts=2,
+        sleep=lambda s: None,
+    )
+    assert rc == 7
+    assert len(calls) == 3  # initial + 2 restarts
+
+
+def test_run_with_restarts_zero_budget_fails_fast():
+    from bluefog_tpu.run.run import run_with_restarts
+
+    calls = []
+    rc = run_with_restarts(
+        lambda: calls.append(1) or 3, max_restarts=0,
+        sleep=lambda s: (_ for _ in ()).throw(AssertionError("no sleep")),
+    )
+    assert rc == 3 and len(calls) == 1
