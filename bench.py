@@ -40,6 +40,12 @@ Individual families via ``BENCH_MODE``:
   timed with the device metric tier off vs on (interval 10), the
   bitwise on/off state pin, and a drained-registry sample; asserts the
   <2 % overhead acceptance bound. See ``docs/metrics.md``.
+- ``flight``: flight-recorder evidence — per-event ring-write cost x
+  exact events/step over the differenced step time (<=1 % bound,
+  asserted), bitwise on/off trajectory pin, and a fault-plan kill whose
+  dumps are fused by ``tools/trace_merge.py`` (merged-trace round count
+  vs the compiled CommPlan, hang postmortem naming the killed rank and
+  the stalled edges/rounds). See ``docs/flight.md``.
 
 Timing windows that come out degenerate (a clamped ``diff <= 0`` in
 ``timed_differenced`` — an ambient stall ate the differenced half) are
@@ -1350,6 +1356,324 @@ def run_elastic() -> int:
     return 0
 
 
+def run_flight() -> int:
+    """Flight-recorder evidence (``BENCH_MODE=flight``): the black box
+    must cost ~nothing and the postmortem must be right. Three claims,
+    each measured the way it is resolvable (the direct-A/B noise-floor
+    lesson of BENCH_MODE=metrics applies here too):
+
+    1. **Overhead <= 1 % per step** (recorder is on by default). Primary
+       measurement is analytic decomposition: the per-event ring-write
+       cost (tight microbenchmark, best-of-windows) times the exact
+       events-per-step count (read off the ring's sequence numbers)
+       over the differenced-harness step time. A direct interleaved
+       on/off A/B with an off/off A/A control is published next to it
+       as the honest end-to-end cross-check (NOT asserted: its noise
+       floor on a shared host exceeds the bound being claimed).
+    2. **Bitwise-identical trajectory** recorder on vs off (recording
+       never touches device values; pinned here every round).
+    3. **Postmortem correctness**: a BLUEFOG_FAULT_PLAN-killed rank on
+       the 8-worker mesh, dumps + timeline fused by
+       ``tools/trace_merge.py`` — the merged Perfetto JSON must be
+       valid, its per-step round count must match the independently
+       compiled CommPlan, and the hang postmortem must name the killed
+       rank and the exact edge/round each neighbor stalled on.
+    """
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_FLIGHT_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import itertools
+    import tempfile
+    import time as time_mod
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import flight as bf_flight
+    from bluefog_tpu.collective.plan import plan_from_topology
+
+    devices = jax.devices()
+    n = min(len(devices), int(os.environ.get("BENCH_FLIGHT_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_FLIGHT_DIM", "512"))
+    layers = int(os.environ.get("BENCH_FLIGHT_LAYERS", "12"))
+    batch = int(os.environ.get("BENCH_FLIGHT_BATCH", "32"))
+    samples = max(24, int(os.environ.get("BENCH_FLIGHT_SAMPLES", "90")))
+    kill_step = int(os.environ.get("BENCH_FLIGHT_KILL_STEP", "5"))
+    pm_steps = int(os.environ.get("BENCH_FLIGHT_STEPS", "12"))
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_FLIGHT", "BLUEFOG_FLIGHT_DIR",
+                  "BLUEFOG_TIMELINE")
+    }
+    os.environ.pop("BLUEFOG_FLIGHT_DIR", None)
+    os.environ.pop("BLUEFOG_TIMELINE", None)
+
+    bf.init(devices=devices[:n])
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+
+    rng = np.random.RandomState(0)
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    xs = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+    ys = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def make_stepper():
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+        train_step = bf.make_train_step(opt, loss_fn)
+        params = {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+        carry = [(params, opt.init(params))]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs, ys)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, carry
+
+    def set_flight(on: bool):
+        os.environ["BLUEFOG_FLIGHT"] = "1" if on else "0"
+        bf_flight.reconfigure()
+
+    try:
+        # -- claim 1a: per-event ring-write cost (microbenchmark) ------------
+        set_flight(True)
+        n_calls = 200_000
+        per_event = []
+        for _ in range(5):
+            t0 = time_mod.perf_counter()
+            for _i in range(n_calls):
+                bf_flight.record("bench", step=1, comm=True)
+            per_event.append((time_mod.perf_counter() - t0) / n_calls)
+        per_event_s = min(per_event)
+
+        # -- claim 1b: exact events-per-step, from ring sequence numbers -----
+        set_flight(True)
+        stepper, _carry = make_stepper()
+        stepper()  # compile outside the counted window
+        _settle(stepper())
+        before = max(
+            (e["seq"] for e in bf_flight.events()), default=0
+        )
+        count_steps = 10
+        for _ in range(count_steps):
+            stepper()
+        _settle(stepper())
+        after = max((e["seq"] for e in bf_flight.events()), default=0)
+        events_per_step = (after - before) / (count_steps + 1)
+
+        # -- claim 1c: step time (differenced harness), recorder ON ----------
+        step_times = _timed_differenced(stepper, 10, 4)
+        step_s = step_times[0]
+        overhead_pct = (
+            100.0 * events_per_step * per_event_s / step_s
+            if step_s > 0 else 0.0
+        )
+
+        # -- cross-check: direct interleaved A/B + A/A control (disclosed) ---
+        steppers = {}
+        for variant in ("off", "on", "off2"):
+            set_flight(variant == "on")
+            steppers[variant], _ = make_stepper()
+            steppers[variant]()
+            _settle(steppers[variant]())
+        orders = list(itertools.permutations(("off", "on", "off2")))
+        times = {v: [] for v in steppers}
+        for i in range(samples):
+            for variant in orders[i % len(orders)]:
+                set_flight(variant == "on")
+                t0 = time_mod.perf_counter()
+                _settle(steppers[variant]())
+                times[variant].append(time_mod.perf_counter() - t0)
+
+        def median(v):
+            v = sorted(v)
+            return v[len(v) // 2] if v else 0.0
+
+        base_s = median(times["off"])
+        direct_pct = (
+            100.0 * median([b - a for a, b in zip(times["off"],
+                                                  times["on"])]) / base_s
+            if base_s > 0 else 0.0
+        )
+        control_pct = (
+            100.0 * median([b - a for a, b in zip(times["off"],
+                                                  times["off2"])]) / base_s
+            if base_s > 0 else 0.0
+        )
+
+        # -- claim 2: bitwise trajectory pin, on vs off ----------------------
+        state_bits = {}
+        for variant in ("off", "on"):
+            set_flight(variant == "on")
+            _step, carry = make_stepper()
+            for _ in range(12):
+                _step()
+            state_bits[variant] = jax.tree_util.tree_leaves(carry[0])
+        bitwise = all(
+            bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(state_bits["off"], state_bits["on"])
+        )
+
+        print(json.dumps({
+            "metric": "flight_recorder_overhead",
+            "n_workers": n,
+            "payload_mb": round(layers * dim * dim * 4 / 1e6, 2),
+            "per_event_us": round(per_event_s * 1e6, 3),
+            "events_per_step": round(events_per_step, 2),
+            "ms_per_step": round(step_s * 1e3, 3),
+            "overhead_pct": round(overhead_pct, 4),
+            "method": (
+                "analytic: per-event ring-write cost x exact "
+                "events/step over the differenced step time"
+            ),
+            "direct_ab_pct": round(direct_pct, 3),
+            "control_aa_pct": round(control_pct, 3),
+            "direct_ab_note": (
+                "interleaved per-step median delta; disclosed as the "
+                "end-to-end cross-check, not asserted (shared-host "
+                "noise floor exceeds the 1% bound)"
+            ),
+            "bitwise_identical": bitwise,
+            "samples": samples,
+        }))
+
+        # -- claim 3: kill -> dump -> merge -> postmortem --------------------
+        bf.shutdown()
+        dump_dir = tempfile.mkdtemp(prefix="bf_flight_")
+        os.environ["BLUEFOG_FLIGHT_DIR"] = dump_dir
+        os.environ["BLUEFOG_TIMELINE"] = os.path.join(dump_dir, "trace_")
+        os.environ["BLUEFOG_FLIGHT"] = "1"
+        bf.init(devices=devices[:n])
+        bf.set_topology(topo.ExponentialTwoGraph(n))
+        kill_rank = n // 2
+        session = bf.elastic.start(policy="average")
+        session.inject("kill", rank=kill_rank, step=kill_step)
+        opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.05))
+        guard = bf.elastic.guard(opt)
+        params = {"w": bf.worker_values(
+            lambda r: rng.randn(dim).astype(np.float32)
+        )}
+        state = opt.init(params)
+        for _t in range(pm_steps):
+            params, state = guard.step(
+                params, state,
+                {"w": bf.worker_values(np.zeros(dim, np.float32))},
+            )
+        bf.flight_dump()
+        bf.elastic.stop()
+        bf.shutdown()  # closes the env-owned timeline -> valid JSON
+
+        from tools.trace_merge import merge_and_analyze
+
+        merged, report = merge_and_analyze(dump_dir)
+        merged_valid = isinstance(
+            json.loads(json.dumps(merged))["traceEvents"], list
+        )
+        # independent ground truth: compile the same topology again
+        base_plan = plan_from_topology(topo.ExponentialTwoGraph(n))
+        pre_kill = [
+            s for s in report["per_step_rounds"] if s["step"] < kill_step
+        ]
+        rounds_match = bool(pre_kill) and all(
+            s["rounds"] == len(base_plan.rounds) for s in pre_kill
+        )
+        pm = report["hang_postmortem"] or {}
+        waiters = pm.get("waiters", [])
+        rounds_by_edge = {}
+        for ri, rnd in enumerate(base_plan.rounds):
+            for s, d in rnd.perm:
+                rounds_by_edge.setdefault((s, d), ri)
+        expected_waiters = sorted(
+            d for (s, d) in rounds_by_edge if s == kill_rank
+        )
+        postmortem_ok = (
+            pm.get("dead_ranks") == [kill_rank]
+            and sorted(w["rank"] for w in waiters) == expected_waiters
+            and all(
+                w["waiting_on"] == kill_rank
+                and rounds_by_edge.get((kill_rank, w["rank"]))
+                == w["round"]
+                for w in waiters
+            )
+            # the DEAD verdict itself must have gone to disk (the
+            # automatic trigger, not just the explicit end-of-run dump)
+            and any(
+                str(r).startswith("verdict:dead")
+                for r in pm.get("dump_reasons", [])
+            )
+        )
+        print(json.dumps({
+            "metric": "flight_trace_merge",
+            "n_workers": n,
+            "merged_events": len(merged["traceEvents"]),
+            "merged_valid_json": merged_valid,
+            "plan_rounds_compiled": len(base_plan.rounds),
+            "plan_rounds_reported": report["plan_rounds"],
+            "per_step_rounds_match_plan": rounds_match,
+            "steps_analyzed": len(report["steps"]),
+        }))
+        print(json.dumps({
+            "metric": "flight_postmortem",
+            "kill_rank": kill_rank,
+            "kill_step": kill_step,
+            "dead_ranks_reported": pm.get("dead_ranks"),
+            "waiters": waiters,
+            "expected_waiters": expected_waiters,
+            "last_completed_step": pm.get("last_completed_step"),
+            "dump_reasons": pm.get("dump_reasons"),
+            "named_correctly": postmortem_ok,
+        }))
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        bf_flight.reconfigure()
+
+    if os.environ.get("BENCH_ASSERT", "1") == "1":
+        assert bitwise, (
+            "enabling the flight recorder changed the training state"
+        )
+        assert overhead_pct <= 1.0, (
+            f"flight recorder overhead {overhead_pct:.3f}% exceeds the "
+            "1% acceptance bound"
+        )
+        assert merged_valid and rounds_match, (
+            "merged trace invalid or round counts diverge from the "
+            "compiled CommPlan"
+        )
+        assert postmortem_ok, (
+            f"postmortem failed to name the killed rank/edges: {pm}"
+        )
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -1544,7 +1868,7 @@ def run_all() -> int:
     import subprocess
 
     for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
-                 "gossip", "flash", "transformer"):
+                 "flight", "gossip", "flash", "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -1588,6 +1912,8 @@ def main() -> int:
         return run_overlap()
     if mode == "metrics":
         return run_metrics()
+    if mode == "flight":
+        return run_flight()
     if mode == "gossip":
         return run_gossip_overhead()
     if mode == "transformer":

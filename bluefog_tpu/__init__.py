@@ -86,6 +86,8 @@ from bluefog_tpu.timeline import (
     timeline_context,
 )
 from bluefog_tpu.logging_util import logger, set_log_level
+from bluefog_tpu import flight
+from bluefog_tpu.flight import dump as flight_dump
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
     metrics_export,
@@ -329,6 +331,8 @@ __all__ = [
     "timeline_record_counter",
     "timeline_context",
     "elastic",
+    "flight",
+    "flight_dump",
     "metrics",
     "metrics_snapshot",
     "metrics_export",

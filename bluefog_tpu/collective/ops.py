@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import flight
 from bluefog_tpu import metrics
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
@@ -89,6 +90,10 @@ def synchronize(handle: int):
     coordinator stall scan, operations.cc:388-433, re-targeted at host
     blocking points)."""
     result, post = _handle_map.pop(handle)
+    # The host blocking point is where a hang becomes observable: the
+    # flight ring gets the begin/ready pair so a postmortem can name
+    # the last wait each rank completed and the one it died inside.
+    flight.record("sync_begin", handle=handle)
     with watchdog.watch(f"synchronize(handle {handle})"):
         if tl.timeline_enabled():
             t0 = tl.timeline_now_us()
@@ -99,6 +104,7 @@ def synchronize(handle: int):
             )
         else:
             result = jax.block_until_ready(result)
+    flight.record("sync_ready", handle=handle)
     return post(result) if post is not None else result
 
 
@@ -167,6 +173,7 @@ def _compiled(ctx, name, key, fn, in_specs, out_specs, mesh=None):
         # new program build (retrace): the metric every cache-key bug
         # shows up in first — a healthy loop recompiles O(1) times total
         metrics.counter("bluefog.recompiles").inc()
+        flight.record("compile", name=name)
         jitted = jax.jit(
             jax.shard_map(
                 fn, mesh=mesh or ctx.mesh, in_specs=in_specs, out_specs=out_specs
@@ -229,6 +236,9 @@ def _static_plan(ctx) -> CommPlan:
             topo, weighted=ctx.is_topo_weighted(), method=method
         )
         ctx.op_cache[key] = plan
+        # flight side table: the postmortem resolves "which edge/round
+        # was rank j waiting on" from this plan structure
+        flight.note_plan(plan, ctx.topo_version, ctx.live_token())
     return plan
 
 
@@ -280,7 +290,7 @@ def _resolve_plan(
                     f"src_weights for rank {r} contains {sorted(keys - in_sets[r])} "
                     "which are not in-neighbors of the current topology."
                 )
-    return plan_from_weights(
+    plan = plan_from_weights(
         ctx.size,
         self_weight,
         src_weights,
@@ -288,6 +298,11 @@ def _resolve_plan(
         enable_topo_check=enable_topo_check and dst_weights is not None,
         method=_plan_method(),
     )
+    # explicit-weight plans are rebuilt per call (no cache in front of
+    # them); note_plan dedups, so the postmortem side table still learns
+    # each distinct structure exactly once
+    flight.note_plan(plan, ctx.topo_version, ctx.live_token())
+    return plan
 
 
 # -- classic collectives -----------------------------------------------------
@@ -500,6 +515,9 @@ def hierarchical_neighbor_allreduce_nonblocking(
                 mtopo, weighted=ctx.is_machine_topo_weighted(), method=method
             )
             ctx.op_cache[key] = mplan
+            flight.note_plan(
+                mplan, ctx.machine_topo_version, kind="machine"
+            )
     else:
         assert self_weight is not None and neighbor_machine_weights is not None, (
             "self_weight and neighbor_machine_weights must be presented "

@@ -344,10 +344,15 @@ def init(
         )
     # Reference behavior: BLUEFOG_TIMELINE=<prefix> activates tracing at
     # init (operations.cc:464-473).
+    from bluefog_tpu import flight as _flight
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
     _tl.maybe_init_from_env()
+    # Flight recorder opens AFTER the timeline so its session_start
+    # clock handshake can pair the timeline clock with wall/monotonic —
+    # the anchor tools/trace_merge.py aligns ranks with.
+    _flight.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -363,10 +368,15 @@ def shutdown() -> None:
     theirs to close)."""
     global _context
     from bluefog_tpu import elastic as _elastic
+    from bluefog_tpu import flight as _flight
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
     _elastic.stop()
+    if _context is not None:
+        # session_end lands in the ring (and the crash hooks detach)
+        # while the timeline is still open for the clock pairing
+        _flight.on_shutdown()
 
     # Final flush of deferred device drains + the env-configured
     # exporters (JSONL / Prometheus / timeline counters) BEFORE an

@@ -20,6 +20,8 @@ import enum
 import threading
 from typing import Dict, Optional, Tuple
 
+from bluefog_tpu import flight
+
 __all__ = ["RankState", "Membership"]
 
 
@@ -117,7 +119,14 @@ class Membership:
             self.epoch += 1
             self._reasons[rank] = (reason, step)
             self.history.append((rank, state.value, reason, step))
-            return True
+            epoch = self.epoch
+        # flight-recorder event outside the lock: every verdict is part
+        # of the postmortem record (who was condemned, when, and why)
+        flight.record(
+            "membership", rank=rank, state=state.value, reason=reason,
+            step=step, epoch=epoch,
+        )
+        return True
 
     def mark_suspect(self, rank: int, reason: str = "deadline",
                      step: Optional[int] = None) -> bool:
@@ -149,7 +158,12 @@ class Membership:
             self._degraded[rank] = factor
             self.epoch += 1
             self.history.append((rank, "degraded", f"factor={factor}", step))
-            return True
+            epoch = self.epoch
+        flight.record(
+            "membership", rank=rank, state="degraded",
+            reason=f"factor={factor}", step=step, epoch=epoch,
+        )
+        return True
 
     def revive(self, rank: int, step: Optional[int] = None) -> bool:
         """Re-admit a rank (rejoin path,

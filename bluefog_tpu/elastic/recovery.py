@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
@@ -192,10 +193,17 @@ class ElasticSession:
         limit = self.liveness_timeout_s()
         if limit <= 0 or waited < limit:
             return
-        for r in self._last_dispatch_ranks:
-            if self.membership.mark_suspect(r, f"stall:{name}", self.step):
-                metrics_mod.counter("bluefog.elastic.suspects").inc()
+        suspected = [
+            r for r in self._last_dispatch_ranks
+            if self.membership.mark_suspect(r, f"stall:{name}", self.step)
+        ]
+        for _ in suspected:
+            metrics_mod.counter("bluefog.elastic.suspects").inc()
         tl.timeline_record_instant(f"elastic:suspect {name}", "LIVENESS")
+        if suspected:
+            # SUSPECT verdicts are a dump trigger: the run may be about
+            # to die, so the black box goes to disk while it still can
+            flight.maybe_dump(f"verdict:suspect:{name}")
 
     def close(self) -> None:
         watchdog.remove_stall_handler(self._on_stall)
@@ -219,12 +227,22 @@ class ElasticSession:
 
     def _apply_fault(self, fault: Fault, step: int) -> None:
         metrics_mod.counter("bluefog.elastic.faults").inc()
+        # the fault event carries the topology version it fired under:
+        # the postmortem resolves "which edge/round were the neighbors
+        # waiting on" against the plan compiled for THAT version (the
+        # repair below bumps it)
+        flight.note_fault(
+            fault_kind=fault.kind, rank=fault.rank, step=step,
+            seconds=fault.seconds, factor=fault.factor,
+            topo_version=self.ctx.topo_version,
+        )
         if fault.kind == "kill":
             if self.membership.mark_dead(fault.rank, "killed", step):
                 self._unrepaired[fault.rank] = step
                 tl.timeline_record_instant(
                     f"elastic:kill rank={fault.rank}", "FAULT"
                 )
+                flight.maybe_dump(f"verdict:dead:rank={fault.rank}")
         elif fault.kind == "stall":
             limit = self.liveness_timeout_s()
             if limit > 0 and fault.seconds >= limit:
@@ -238,6 +256,9 @@ class ElasticSession:
                     step,
                 ):
                     self._unrepaired[fault.rank] = step
+                    flight.maybe_dump(
+                        f"verdict:dead:rank={fault.rank}"
+                    )
                 tl.timeline_record_instant(
                     f"elastic:stall-condemned rank={fault.rank}", "FAULT"
                 )
@@ -439,6 +460,11 @@ class ElasticSession:
         tl.timeline_record_instant(
             f"elastic:repair step={step} dead={list(record.dead)} "
             f"policy={policy}", "REPAIR",
+        )
+        flight.record(
+            "repair", step=step, dead=list(record.dead),
+            live=list(live), policy=policy, epoch=record.epoch,
+            topo_version=record.topo_version,
         )
         logger.warning(
             "elastic repair at step %d: dead=%s live=%s policy=%s "

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import optax
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
@@ -503,6 +504,10 @@ class _GossipOptimizer:
                         f"opt.schedule is sized for {sched.size} workers "
                         f"but the mesh has {ctx.size}"
                     )
+                for p in sched.plans:
+                    # deduped: the whole period lands in the postmortem
+                    # side table once, however many steps dispatch
+                    flight.note_plan(p, ctx.topo_version, ctx.live_token())
                 return (
                     (sched,),
                     lambda t, step, wops: inner.neighbor_allreduce_step(
@@ -692,7 +697,7 @@ class _GossipOptimizer:
         if self.neighbor_machine_weights is not None:
             from bluefog_tpu.collective.plan import plan_from_weights
 
-            return plan_from_weights(
+            mplan = plan_from_weights(
                 ctx.machine_size,
                 self.self_weight if self.self_weight is not None else 0.5,
                 self.neighbor_machine_weights,
@@ -700,6 +705,10 @@ class _GossipOptimizer:
                 enable_topo_check=self.enable_topo_check
                 and self.send_neighbor_machines is not None,
             )
+            flight.note_plan(
+                mplan, ctx.machine_topo_version, kind="machine"
+            )
+            return mplan
         mtopo = ctx.load_machine_topology()
         assert mtopo is not None, (
             "hierarchical optimizer needs bf.set_machine_topology() or "
@@ -713,6 +722,9 @@ class _GossipOptimizer:
                 mtopo, weighted=ctx.is_machine_topo_weighted()
             )
             ctx.op_cache[key] = plan
+            flight.note_plan(
+                plan, ctx.machine_topo_version, kind="machine"
+            )
         return plan
 
     # -- error-feedback state (compression='int8_ef') ------------------------
@@ -934,6 +946,7 @@ class _GossipOptimizer:
         fn = ctx.op_cache.get(key)
         if fn is None:
             metrics_mod.counter("bluefog.recompiles").inc()
+            flight.record("compile", name="opt_step")
             order = self.order
             tx = self._tx
 
@@ -971,6 +984,7 @@ class _GossipOptimizer:
         # dynamic schedules advance per COMMUNICATION, not per call, so a
         # K>1 optimizer still walks every topology in the schedule
         step_idx = jnp.asarray([self._comm_count], jnp.int32)
+        flight.record("step_begin", step=self._step_count, comm=comm_now)
         self._step_count += 1
         if comm_now:
             self._comm_count += 1
@@ -981,6 +995,7 @@ class _GossipOptimizer:
             "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
             ef_in,
         )
+        flight.record("step_dispatched", step=self._step_count - 1)
         if ef:
             self._ef = ef_out
         if met:
@@ -1120,6 +1135,7 @@ class _GossipOptimizer:
             fn = ctx.op_cache.get(key)
             if fn is None:
                 metrics_mod.counter("bluefog.recompiles").inc()
+                flight.record("compile", name="opt_fused_step")
                 order = self.order
                 tx = self._tx
                 has_accum = accum is not None
@@ -1261,6 +1277,10 @@ class _GossipOptimizer:
                 )
                 ctx.op_cache[key] = fn
             step_idx = jnp.asarray([self._comm_count], jnp.int32)
+            flight.record(
+                "step_begin", step=self._step_count, comm=comm_now,
+                fused=True,
+            )
             self._step_count += 1
             if comm_now:
                 self._comm_count += 1
@@ -1312,6 +1332,7 @@ class _GossipOptimizer:
                     self._drain_after_sample(
                         None if delay_now else wire_now, met_o[0]
                     )
+            flight.record("step_dispatched", step=self._step_count - 1)
             if has_aux:
                 return params_o, state_o, (loss, aux)
             return params_o, state_o, loss

@@ -47,6 +47,8 @@ __all__ = [
     "resolve_max_restarts",
     "backoff_seconds",
     "run_with_restarts",
+    "flight_artifacts",
+    "report_flight_artifacts",
     "main",
 ]
 
@@ -110,6 +112,14 @@ def parse_args(argv: Sequence[str] = None) -> argparse.Namespace:
         "--timeline-filename", action="store", dest="timeline_filename",
         help="Prefix for per-process Chrome-trace timeline files "
         "(sets BLUEFOG_TIMELINE).",
+    )
+    parser.add_argument(
+        "--flight-dir", action="store", dest="flight_dir",
+        help="Directory for flight-recorder dumps (sets "
+        "BLUEFOG_FLIGHT_DIR): each process writes "
+        "flight_<process_id>.json there on stall/verdict/crash/SIGTERM, "
+        "and the launcher lists the collected artifacts after a failed "
+        "run — fuse them with tools/trace_merge.py (docs/flight.md).",
     )
     parser.add_argument(
         "--remote-python", action="store", dest="remote_python",
@@ -186,6 +196,8 @@ def build_child_env(
             )
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    if getattr(args, "flight_dir", None):
+        env["BLUEFOG_FLIGHT_DIR"] = args.flight_dir
     if args.coordinator:
         env["BLUEFOG_COORDINATOR"] = args.coordinator
         env["BLUEFOG_NUM_PROCESSES"] = str(args.num_processes)
@@ -245,6 +257,42 @@ def run_with_restarts(
             )
         sleep(delay)
         attempt += 1
+
+
+def flight_artifacts(flight_dir: str) -> List[str]:
+    """The postmortem files a failed run left behind (pure; unit
+    tested): flight dumps and per-process timeline JSONs under
+    ``--flight-dir``, sorted. Empty when the directory is missing —
+    a failure before any dump trigger is not a launcher error."""
+    if not flight_dir or not os.path.isdir(flight_dir):
+        return []
+    return sorted(
+        os.path.join(flight_dir, f)
+        for f in os.listdir(flight_dir)
+        if f.endswith(".json")
+    )
+
+
+def report_flight_artifacts(flight_dir: str, out=None) -> List[str]:
+    """After a nonzero exit: list the collected per-rank dumps/traces
+    and print the one command that fuses them into a postmortem. The
+    launcher is the only place that knows the run failed AND where
+    every process was told to dump — this closes the loop so the
+    operator is never left grepping hosts for evidence."""
+    out = out or sys.stderr
+    files = flight_artifacts(flight_dir)
+    if not files:
+        return files
+    print(
+        f"[bfrun-tpu] flight artifacts in {flight_dir}:", file=out
+    )
+    for f in files:
+        print(f"[bfrun-tpu]   {f}", file=out)
+    print(
+        "[bfrun-tpu] postmortem: python tools/trace_merge.py "
+        f"{flight_dir}", file=out,
+    )
+    return files
 
 
 def _command_argv(
@@ -339,6 +387,11 @@ def main(argv: Sequence[str] = None) -> int:
         print("bfrun-tpu: no command to execute", file=sys.stderr)
         return 2
 
+    if args.flight_dir:
+        # the collection dir must exist before the workers' timeline /
+        # flight writers try to open files inside it
+        os.makedirs(args.flight_dir, exist_ok=True)
+
     if args.hosts or args.hostfile:
         hosts = (
             network_util.parse_hosts(args.hosts)
@@ -396,23 +449,34 @@ def main(argv: Sequence[str] = None) -> int:
                             proc.kill()
                             proc.wait()
 
-            return run_with_restarts(
+            rc = run_with_restarts(
                 launch_pod, max_restarts,
                 log=lambda msg: print(msg, file=sys.stderr),
             )
+            if rc != 0:
+                # SIGTERM from the pod teardown above triggered each
+                # local process's flight dump; remote hosts dumped into
+                # their own --flight-dir (same path, forwarded env)
+                report_flight_artifacts(args.flight_dir)
+            return rc
 
     env = build_child_env(args, base_env=dict(os.environ))
     argv_ = _command_argv(args.command)
     max_restarts = resolve_max_restarts(args)
     if args.verbose:
         print(f"[bfrun-tpu] exec: {' '.join(argv_)}")
-    if max_restarts > 0:
-        # exec would forfeit the supervisor; keep a parent to restart from
-        return run_with_restarts(
+    if max_restarts > 0 or args.flight_dir:
+        # exec would forfeit the supervisor; keep a parent to restart
+        # from — and, with --flight-dir, to list the postmortem
+        # artifacts after a failed run
+        rc = run_with_restarts(
             lambda: subprocess.run(argv_, env=env).returncode,
             max_restarts,
             log=lambda msg: print(msg, file=sys.stderr),
         )
+        if rc != 0:
+            report_flight_artifacts(args.flight_dir)
+        return rc
     os.execvpe(argv_[0], argv_, env)
     raise AssertionError("unreachable")  # pragma: no cover
 

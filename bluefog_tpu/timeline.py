@@ -38,6 +38,7 @@ __all__ = [
     "timeline_record_instant",
     "timeline_record_counter",
     "timeline_context",
+    "process_file_index",
 ]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
@@ -74,32 +75,39 @@ _env_owned = False  # True when the active timeline was opened from
 
 class _PyWriter:
     """Pure-Python fallback writer with the same contract as the native
-    library, used only if g++ is unavailable. Single-threaded, synchronous
-    — fine for a fallback, but the native path is the real design."""
+    library, used only if g++ is unavailable. The native writer serializes
+    records through its queue-draining thread; here records are written
+    synchronously by whoever calls, so the ``,\\n`` separator handshake
+    must be locked — the watchdog thread's stall instants and counter
+    events land concurrently with main-thread spans, and an interleaved
+    write would corrupt the JSON stream."""
 
     def __init__(self):
         self._f = None
         self._first = True
         self._t0 = time.perf_counter_ns()
+        self._wlock = threading.Lock()
 
     def bf_timeline_start(self, path: bytes) -> int:
-        if self._f is not None:
-            return 0
-        self._f = open(path.decode(), "w")
-        self._f.write("[\n")
-        self._first = True
-        return 1
+        with self._wlock:
+            if self._f is not None:
+                return 0
+            self._f = open(path.decode(), "w")
+            self._f.write("[\n")
+            self._first = True
+            return 1
 
     def bf_timeline_now_us(self) -> int:
         return (time.perf_counter_ns() - self._t0) // 1000
 
     def _emit(self, obj: str) -> None:
-        if self._f is None:
-            return
-        if not self._first:
-            self._f.write(",\n")
-        self._first = False
-        self._f.write(obj)
+        with self._wlock:
+            if self._f is None:
+                return
+            if not self._first:
+                self._f.write(",\n")
+            self._first = False
+            self._f.write(obj)
 
     @staticmethod
     def _esc(b: bytes) -> str:
@@ -141,10 +149,11 @@ class _PyWriter:
         )
 
     def bf_timeline_stop(self) -> None:
-        if self._f is not None:
-            self._f.write("\n]\n")
-            self._f.close()
-            self._f = None
+        with self._wlock:
+            if self._f is not None:
+                self._f.write("\n]\n")
+                self._f.close()
+                self._f = None
 
 
 def _load_native():
@@ -280,13 +289,16 @@ def timeline_end_activity(name: str, activity: str = "", rank: int = 0,
 
 
 def timeline_record_complete(name: str, activity: str, start_us: int,
-                             dur_us: int, rank: int = 0, tid: int = 0) -> None:
-    """One complete (ph=X) span with explicit timing."""
+                             dur_us: int, rank: int = 0, tid: int = 0) -> bool:
+    """One complete (ph=X) span with explicit timing. Returns True when
+    the record was handed to the writer (same success contract as every
+    sibling record function)."""
     if not _active:
-        return
+        return False
     _load_native().bf_timeline_record_complete(
         name.encode(), activity.encode(), rank, tid, start_us, dur_us
     )
+    return True
 
 
 def timeline_record_instant(name: str, activity: str = "", rank: int = 0,
@@ -338,18 +350,57 @@ def timeline_context(name: str, activity: str, rank: int = 0):
         timeline_end_activity(name, activity, rank)
 
 
+def process_file_index() -> int:
+    """The index used to name per-process artifact files
+    (``<prefix><index>.json`` timelines, ``flight_<index>.json`` flight
+    dumps): ``BLUEFOG_PROCESS_ID`` when the launcher set it (multi-host),
+    else ``jax.process_index()``, else 0. The env var is consulted first
+    so naming works even before a JAX backend exists."""
+    env = os.environ.get("BLUEFOG_PROCESS_ID")
+    if env is not None:
+        try:
+            return int(env.strip())
+        except ValueError:
+            # fall through to jax rather than defaulting to 0: every
+            # host mapping to 0 would clobber each other's files —
+            # exactly what per-process naming exists to prevent
+            from bluefog_tpu.logging_util import logger
+
+            logger.warning(
+                "BLUEFOG_PROCESS_ID=%r is not an integer; using "
+                "jax.process_index() for artifact file naming", env,
+            )
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 def maybe_init_from_env() -> bool:
     """Honor ``BLUEFOG_TIMELINE=<prefix>`` the way the reference runtime
-    does at init (operations.cc:464-473): writes ``<prefix>0.json`` (one
-    controller process == one file). Registers an atexit flush so a
-    program that never calls shutdown still gets valid JSON."""
+    does at init (operations.cc:464-473): writes
+    ``<prefix><process_index>.json`` — one file per controller process,
+    so multi-host runs stop clobbering each other (the reference names
+    per rank; under single-controller SPMD the process is the writer).
+    Registers an atexit flush so a program that never calls shutdown
+    still gets valid JSON."""
     import atexit
 
     global _env_owned
     prefix = os.environ.get("BLUEFOG_TIMELINE")
     if not prefix or _active:
         return False
-    ok = timeline_init(prefix + "0.json")
+    parent = os.path.dirname(prefix)
+    if parent:
+        # a prefix pointing into a not-yet-created collection dir
+        # (bfrun-tpu --flight-dir) must not silently disable tracing
+        try:
+            os.makedirs(parent, exist_ok=True)
+        except OSError:
+            pass
+    ok = timeline_init(prefix + f"{process_file_index()}.json")
     if ok:
         _env_owned = True
         atexit.register(timeline_shutdown)

@@ -102,36 +102,44 @@ def _monitor() -> None:
         if limit <= 0 or _suspended:
             continue
         now = time.monotonic()
+        fired = []
         with _pending_lock:
             for key, (name, t0, reported) in list(_pending.items()):
                 waited = now - t0
                 if waited > limit and not reported:
                     _pending[key] = (name, t0, True)
-                    logger.error(
-                        "Stall detected: %s has been blocking for %.1f s "
-                        "(limit %.0f s). One or more devices may be hung; "
-                        "on a virtual CPU mesh this is usually a collective "
-                        "rendezvous deadlock (block each dependent dispatch).",
-                        name, waited, limit,
-                    )
-                    # Stalls must reach the exported metrics and the trace,
-                    # not just stderr: a fleet pages on bluefog.stalls, and
-                    # the instant event lands in the timeline next to the
-                    # span that hung.
-                    from bluefog_tpu import metrics, timeline
+                    fired.append((name, waited))
+        # Everything below runs OUTSIDE _pending_lock: handlers can be
+        # slow (the flight recorder writes a dump to disk on stall),
+        # and watch.__enter__/__exit__ take the same lock — a handler
+        # holding it would turn a recoverable stall into a training
+        # thread blocked on its own watchdog.
+        for name, waited in fired:
+            logger.error(
+                "Stall detected: %s has been blocking for %.1f s "
+                "(limit %.0f s). One or more devices may be hung; "
+                "on a virtual CPU mesh this is usually a collective "
+                "rendezvous deadlock (block each dependent dispatch).",
+                name, waited, limit,
+            )
+            # Stalls must reach the exported metrics and the trace,
+            # not just stderr: a fleet pages on bluefog.stalls, and
+            # the instant event lands in the timeline next to the
+            # span that hung.
+            from bluefog_tpu import metrics, timeline
 
-                    metrics.counter("bluefog.stalls").inc()
-                    timeline.timeline_record_instant(
-                        f"stall:{name}", "STALL"
+            metrics.counter("bluefog.stalls").inc()
+            timeline.timeline_record_instant(
+                f"stall:{name}", "STALL"
+            )
+            for handler in list(_handlers):
+                try:
+                    handler(name, waited)
+                except Exception:  # a liveness bug must not
+                    # kill the monitor thread
+                    logger.exception(
+                        "stall handler %r raised", handler
                     )
-                    for handler in list(_handlers):
-                        try:
-                            handler(name, waited)
-                        except Exception:  # a liveness bug must not
-                            # kill the monitor thread
-                            logger.exception(
-                                "stall handler %r raised", handler
-                            )
 
 
 class watch:

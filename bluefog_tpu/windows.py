@@ -48,6 +48,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu.collective import ops as col_ops
 from bluefog_tpu.topology.graphs import GetRecvWeights
@@ -445,6 +446,7 @@ def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
     # window-op accounting: exported alongside the gossip-health metrics
     # so window-family traffic is visible in the same registry
     metrics_mod.counter(f"bluefog.window_ops.{mode}").inc()
+    flight.record("window_op", op=mode, window=win.name)
     self_vec = _self_weight_vec(ctx, self_weight, participating)
     perms, slot_table = _lowered_exchange(ctx, win, w_edges)
     fn = _exchange_fn(ctx, win, mode, perms, slot_table, _p_enabled())
@@ -730,6 +732,7 @@ def win_update(
     ctx = ctx_mod.get_context()
     win = _get_win(ctx, name)
     metrics_mod.counter("bluefog.window_ops.update").inc()
+    flight.record("window_op", op="update", window=win.name)
     self_vec, w_recv, participating = _update_weights(
         ctx, win, self_weight, neighbor_weights
     )

@@ -53,12 +53,15 @@ def targets():
 
 
 def test_shutdown_keeps_user_opened_timeline(tmp_path):
+    import json
+
     path = str(tmp_path / "user_timeline.json")
     assert tl.timeline_init(path)
     bf.shutdown()
     # the user opened it; shutdown must leave it active for them to close
     assert tl.timeline_enabled()
     assert tl.timeline_shutdown()
+    assert isinstance(json.load(open(path)), list)  # valid trace JSON
 
 
 def test_shutdown_closes_env_opened_timeline(tmp_path, monkeypatch, cpu_devices):
@@ -69,7 +72,9 @@ def test_shutdown_closes_env_opened_timeline(tmp_path, monkeypatch, cpu_devices)
     assert tl.timeline_enabled() and tl.timeline_env_owned()
     bf.shutdown()
     assert not tl.timeline_enabled()
-    assert os.path.exists(prefix + "0.json")
+    import json
+
+    assert isinstance(json.load(open(prefix + "0.json")), list)
 
 
 # -- associated-p lifecycle --------------------------------------------------

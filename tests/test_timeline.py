@@ -142,6 +142,147 @@ def test_watchdog_reports_stall(caplog):
         watchdog.set_stall_timeout(60)
 
 
+def test_record_complete_returns_bool(tmp_path):
+    """timeline_record_complete reports success like every sibling
+    record function (it used to return None)."""
+    assert tl.timeline_record_complete("x", "CAT", 0, 1) is False
+    assert bf.timeline_init(str(tmp_path / "rc.json"))
+    assert tl.timeline_record_complete("x", "CAT", 0, 1) is True
+    assert bf.timeline_shutdown()
+
+
+def test_pywriter_concurrent_records_stay_valid_json(tmp_path):
+    """The pure-Python fallback writer is hit concurrently by the
+    watchdog thread (stall instants, counters) and the main thread
+    (spans); its separator handshake is locked so the stream stays
+    parseable. Hammer it from 4 threads and parse the result."""
+    import threading
+
+    from bluefog_tpu.timeline import _PyWriter
+
+    w = _PyWriter()
+    path = tmp_path / "py.json"
+    assert w.bf_timeline_start(str(path).encode())
+
+    def spam(tid):
+        for _ in range(200):
+            w.bf_timeline_record(b"span", b"CAT", b"B", 0, tid)
+            w.bf_timeline_record_counter(b"ctr", b"CAT", 0, tid, 1.5)
+            w.bf_timeline_record(b"span", b"CAT", b"E", 0, tid)
+
+    threads = [
+        threading.Thread(target=spam, args=(t,)) for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    w.bf_timeline_stop()
+    events = json.load(open(path))  # corruption -> JSONDecodeError
+    assert len(events) == 4 * 200 * 3
+
+
+def test_counter_nonfinite_guard_regression(tmp_path):
+    """Non-finite counter values must be DROPPED (returning False), not
+    serialized: %g would emit bare nan/inf tokens and invalidate the
+    whole trace as JSON — exactly when training diverges and the trace
+    matters most."""
+    path = str(tmp_path / "nonfinite.json")
+    assert bf.timeline_init(path)
+    assert bf.timeline_record_counter("ok", 1.0) is True
+    assert bf.timeline_record_counter("bad", float("nan")) is False
+    assert bf.timeline_record_counter("bad", float("inf")) is False
+    assert bf.timeline_record_counter("bad", float("-inf")) is False
+    assert bf.timeline_shutdown()
+    events = json.load(open(path))  # the file must still parse
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert {e["name"] for e in counters} == {"ok"}
+
+
+def test_env_activation_uses_process_index(tmp_path, monkeypatch,
+                                           cpu_devices):
+    """Multi-host runs must not clobber each other's trace file:
+    BLUEFOG_TIMELINE=<prefix> writes <prefix><process_index>.json, with
+    the index from BLUEFOG_PROCESS_ID (the launcher contract)."""
+    assert tl.process_file_index() == 0  # single-controller default
+    monkeypatch.setenv("BLUEFOG_PROCESS_ID", "3")
+    assert tl.process_file_index() == 3
+    prefix = str(tmp_path / "proc_")
+    monkeypatch.setenv("BLUEFOG_TIMELINE", prefix)
+    assert tl.maybe_init_from_env()
+    bf.allreduce(bf.worker_values(np.float32(1)))
+    bf.timeline_shutdown()
+    assert not os.path.exists(prefix + "0.json")
+    events = json.load(open(prefix + "3.json"))
+    assert isinstance(events, list)
+
+
+def test_watchdog_suspend_resume_clock_restart(caplog):
+    """A suspended interval must NOT count toward a stall: resume()
+    restarts every pending wait's clock (the notebook-pause contract of
+    the reference bf.suspend)."""
+    watchdog.set_stall_timeout(0.3)
+    bf.logger.propagate = True
+    try:
+        with caplog.at_level("ERROR", logger="bluefog_tpu"):
+            with watchdog.watch("suspended-op"):
+                watchdog.suspend()
+                time.sleep(0.6)  # past the limit, but suspended
+                watchdog.resume()  # clock restarts here
+                time.sleep(0.1)  # under the limit since resume
+            assert not any(
+                "Stall detected" in r.message for r in caplog.records
+            ), "suspended interval was counted toward the stall"
+            # the SAME deadline still fires once the post-resume wait
+            # genuinely exceeds it (resume must re-arm, not disable)
+            with watchdog.watch("post-resume-op"):
+                time.sleep(0.7)
+        assert any(
+            "post-resume-op" in r.message for r in caplog.records
+        )
+    finally:
+        bf.logger.propagate = False
+        watchdog.resume()
+        watchdog.set_stall_timeout(60)
+
+
+def test_stall_handler_exception_isolated(caplog):
+    """A raising stall handler must neither kill the monitor thread nor
+    skip the handlers after it."""
+    calls = []
+
+    def bad(name, waited):
+        raise RuntimeError("handler boom")
+
+    def good(name, waited):
+        calls.append(name)
+
+    watchdog.add_stall_handler(bad)
+    watchdog.add_stall_handler(good)  # registered AFTER the raiser
+    watchdog.set_stall_timeout(0.1)
+    bf.logger.propagate = True
+    try:
+        with caplog.at_level("ERROR", logger="bluefog_tpu"):
+            with watchdog.watch("iso-op"):
+                time.sleep(0.5)
+            assert "iso-op" in calls, (
+                "handler after the raiser was skipped"
+            )
+            assert any(
+                "stall handler" in r.message for r in caplog.records
+            )
+            # monitor thread survived: a later stall still reports
+            calls.clear()
+            with watchdog.watch("iso-op-2"):
+                time.sleep(0.5)
+        assert "iso-op-2" in calls, "monitor thread died"
+    finally:
+        bf.logger.propagate = False
+        watchdog.remove_stall_handler(bad)
+        watchdog.remove_stall_handler(good)
+        watchdog.set_stall_timeout(60)
+
+
 def test_watchdog_quiet_when_fast(caplog):
     watchdog.set_stall_timeout(5)
     bf.logger.propagate = True

@@ -14,13 +14,22 @@ Usage::
 
     python tools/metrics_report.py run.jsonl            # human table
     python tools/metrics_report.py run.jsonl --json     # machine-readable
+    python tools/metrics_report.py --flight DUMP_DIR    # flight dumps
+
+``--flight`` treats the path as a flight-recorder dump directory
+(``BLUEFOG_FLIGHT_DIR`` / ``bfrun-tpu --flight-dir``, see
+docs/flight.md) and summarizes each ``flight_*.json``: what triggered
+it, event/stall counts, dead ranks — the 10-second triage before
+running the full ``tools/trace_merge.py`` postmortem.
 
 Exit status is 0 on a parseable file (even an empty one reports
 cleanly), 2 on unreadable input.
 """
 
 import argparse
+import glob
 import json
+import os
 import sys
 
 
@@ -84,14 +93,78 @@ def load(path: str):
     return out
 
 
+def summarize_flight(dump_dir: str) -> dict:
+    """Fold every ``flight_*.json`` in a dump directory into one triage
+    object: per dump the trigger reason, event and stall counts, last
+    event, dead ranks; aggregated dead set on top."""
+    dumps = []
+    for f in sorted(glob.glob(os.path.join(dump_dir, "flight_*.json"))):
+        try:
+            with open(f) as fh:
+                d = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            dumps.append({"file": os.path.basename(f), "unreadable": True})
+            continue
+        events = d.get("events", [])
+        membership = d.get("membership") or {}
+        dumps.append({
+            "file": os.path.basename(f),
+            "process_index": d.get("process_index", 0),
+            "reason": d.get("reason", "?"),
+            "events": len(events),
+            "stalls": sum(1 for e in events if e.get("kind") == "stall"),
+            "last_event": events[-1]["kind"] if events else None,
+            "dead_ranks": membership.get("dead", []),
+            "comm_plans": len(d.get("comm_plans", [])),
+        })
+    dead = sorted({
+        r for d in dumps for r in d.get("dead_ranks", [])
+    })
+    return {"dumps": dumps, "dead_ranks": dead}
+
+
+def _flight_main(path: str, as_json: bool) -> int:
+    if not os.path.isdir(path):
+        print(f"error: {path!r} is not a dump directory", file=sys.stderr)
+        return 2
+    report = summarize_flight(path)
+    if as_json:
+        print(json.dumps(report))
+        return 0
+    if not report["dumps"]:
+        print("no flight_*.json dumps found")
+        return 0
+    for d in report["dumps"]:
+        if d.get("unreadable"):
+            print(f"{d['file']}: unreadable")
+            continue
+        print(
+            f"{d['file']}: proc {d['process_index']}, reason "
+            f"{d['reason']!r}, {d['events']} events "
+            f"(last: {d['last_event']}), {d['stalls']} stalls, "
+            f"dead={d['dead_ranks']}"
+        )
+    print(f"dead ranks (all dumps): {report['dead_ranks']}")
+    print(f"postmortem: python tools/trace_merge.py {path}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="JSONL metrics file")
+    ap.add_argument("path", help="JSONL metrics file (or, with "
+                    "--flight, a flight-dump directory)")
     ap.add_argument(
         "--json", action="store_true",
         help="emit the summary as one JSON object instead of a table",
     )
+    ap.add_argument(
+        "--flight", action="store_true",
+        help="summarize a flight-recorder dump directory instead of a "
+        "metrics JSONL file (docs/flight.md)",
+    )
     args = ap.parse_args(argv)
+    if args.flight:
+        return _flight_main(args.path, args.json)
     try:
         lines = load(args.path)
     except OSError as e:
