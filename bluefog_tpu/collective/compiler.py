@@ -40,10 +40,38 @@ This module is the pass pipeline that fixes that:
 This is the plan-synthesis idea of SCCL ("Synthesizing Optimal
 Collective Algorithms", arXiv:2008.08708) and Swing's offset-selection
 insight applied to the static ``CommPlan`` lowering.
+
+Beyond the latency-optimal point, the compiler carries a **bandwidth
+family** (SCCL's latency×bandwidth Pareto front, Swing's short-cutting):
+
+4. **Chunked, pipelined schedules**: a payload split into ``k``
+   512-element-aligned chunks (quantized-wire scale groups never
+   straddle a split, so chunked output stays bitwise-identical to the
+   monolithic lowering) issued in wavefront order — chunk ``c`` of round
+   ``r`` alongside chunk ``c+1`` of round ``r-1`` — so a multi-round
+   plan's wire time approaches one payload transfer instead of
+   ``rounds`` of them. :func:`choose_chunks` is the alpha-beta Pareto
+   chooser over ``(rounds, chunks, route)`` per payload size;
+   ``BLUEFOG_PLAN_CHUNKS`` is the manual override.
+5. **Short-cut routes** (:func:`shortcut_perms`): a multi-hop edge
+   (virtual ranks far apart under the serpentine torus placement,
+   :mod:`bluefog_tpu.topology.placement`) is decomposed into a relay
+   chain of physically-adjacent unit hops spread over consecutive
+   rounds, so every round's transfers are congestion-free single ICI
+   hops; chunk pipelining then hides the extra relay rounds. Declared
+   via ``BLUEFOG_TORUS_DIMS`` (falls back to the virtual ring).
+6. **Measured calibration** (:func:`calibrate`): a one-shot probe
+   replaces the hardcoded ICI-class ``ROUND_ALPHA_S`` /
+   ``ICI_LINK_BYTES_PER_S`` (and measures how much of the ideal chunk
+   pipelining this backend actually delivers), so the chooser's
+   latency/bandwidth crossover is real on the host it runs on.
 """
 
 import dataclasses
+import os
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from bluefog_tpu.topology import placement as _placement
 
 __all__ = [
     "ROUND_ALPHA_S",
@@ -53,20 +81,27 @@ __all__ = [
     "compile_edges",
     "offset_perms",
     "coloring_perms",
+    "shortcut_perms",
     "min_rounds",
     "round_cost_s",
     "plan_cost_s",
+    "pipelined_cost_s",
+    "choose_chunks",
+    "chunk_option",
+    "calibrate",
+    "calibration",
+    "set_calibration",
+    "clear_calibration",
     "clear_compile_cache",
 ]
 
 # Alpha-beta wire model constants (shared with bluefog_tpu.scaling, which
 # re-exports them for its analytic cost helpers). Values are the v4/v5e
 # ICI class: ~1 us fixed launch + neighbor-hop latency per collective
-# round, ~9e10 B/s per-direction link bandwidth. The *choice* between
-# decompositions depends only on round counts (per-round cost is
-# identical across decompositions of the same payload), so these only
-# need to be order-of-magnitude right; they exist to put a predicted
-# latency number on the plan for observability.
+# round, ~9e10 B/s per-direction link bandwidth. They are the *defaults*
+# of the cost model: a one-shot measured probe (:func:`calibrate`)
+# replaces them at runtime so the chunk chooser's latency/bandwidth
+# crossover reflects the actual host, not the class sheet.
 ROUND_ALPHA_S = 1.0e-6
 ICI_LINK_BYTES_PER_S = 9.0e10
 
@@ -74,15 +109,237 @@ ICI_LINK_BYTES_PER_S = 9.0e10
 # evidence set; the default basis for a plan's recorded predicted cost.
 DEFAULT_PAYLOAD_BYTES = 25_557_032 * 4
 
+# Chunk splits snap to this element width (the int8-wire scale-group
+# width, shared with bluefog_tpu.collective.inner): a chunk boundary off
+# the 512 grid would move elements into different quantization scale
+# groups and break the bitwise chunked==monolithic guarantee.
+CHUNK_ALIGN_ELEMS = 512
 
-def round_cost_s(payload_bytes: float) -> float:
-    """Cost of one ppermute round: fixed latency + payload transfer."""
-    return ROUND_ALPHA_S + payload_bytes / ICI_LINK_BYTES_PER_S
+# Search cap for the chunk chooser (powers of two up to this).
+MAX_CHUNKS = 64
+
+
+# -- measured calibration ----------------------------------------------------
+
+_CAL: Optional[Dict[str, float]] = None
+
+
+def calibration() -> Dict[str, object]:
+    """The active alpha-beta constants: the measured one-shot probe when
+    one has run (or was injected), else the ICI class defaults.
+    ``pipeline_eff`` in [0, 1] is the fraction of ideal chunk-pipeline
+    overlap the backend delivers (1 under the class defaults — the
+    torus-fabric assumption; ~0 on a backend whose independent
+    collectives already overlap, where chunking cannot win)."""
+    if _CAL is not None:
+        return dict(_CAL)
+    return {
+        "alpha_s": ROUND_ALPHA_S,
+        "beta_bytes_per_s": ICI_LINK_BYTES_PER_S,
+        "pipeline_eff": 1.0,
+        "source": "class-constants",
+    }
+
+
+def set_calibration(
+    alpha_s: float,
+    beta_bytes_per_s: float,
+    pipeline_eff: float = 1.0,
+    source: str = "manual",
+) -> None:
+    """Install cost-model constants (tests; or a deployment that probes
+    once and pins the result)."""
+    global _CAL
+    _CAL = {
+        "alpha_s": float(alpha_s),
+        "beta_bytes_per_s": float(beta_bytes_per_s),
+        "pipeline_eff": min(1.0, max(0.0, float(pipeline_eff))),
+        "source": source,
+    }
+
+
+def clear_calibration() -> None:
+    global _CAL
+    _CAL = None
+
+
+def calibrate(
+    force: bool = False,
+    small_elems: int = 2048,
+    large_elems: int = 1 << 21,
+    steps: int = 4,
+    windows: int = 2,
+) -> Dict[str, object]:
+    """One-shot measured probe for the cost-model constants.
+
+    Times three tiny jitted programs on the ambient devices (>= 2
+    required; single-device hosts keep the class constants):
+
+    - a full-ring ppermute at a small payload -> ``alpha_s`` (per-round
+      launch + rendezvous latency);
+    - the same at a large payload -> ``beta_bytes_per_s`` from the byte
+      delta over the time delta;
+    - a 2-round combine at the large payload, monolithic vs 4-chunk
+      wavefront -> ``pipeline_eff``: the measured fraction of the ideal
+      pipelined speedup this backend delivers. On a fabric where
+      independent transfers already overlap (CPU thread pools) the
+      measured gain is ~0 and the chooser will correctly never chunk;
+      on serialized wires the gain approaches the ideal and the chunk
+      crossover lands where the hardware puts it.
+
+    The result is cached process-wide (``force=True`` re-probes) and
+    every cost function below prices with it from then on. Invoked
+    explicitly by ``BENCH_MODE=plan`` and lazily by the chooser when
+    ``BLUEFOG_PLAN_CALIBRATE=1``.
+    """
+    global _CAL
+    if _CAL is not None and not force:
+        # honor ANY installed calibration (a prior probe or a
+        # set_calibration() pin) — a deployment that pinned constants
+        # must not be silently re-probed by the lazy autocalibrate path
+        return dict(_CAL)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bluefog_tpu.timing import timed_differenced
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return calibration()
+    n = min(len(devices), 8)
+    mesh = Mesh(np.array(devices[:n]), ("cal",))
+    fwd = tuple((i, (i + 1) % n) for i in range(n))
+    bwd = tuple((i, (i - 1) % n) for i in range(n))
+
+    def timed(body, elems):
+        x = jax.device_put(
+            np.random.RandomState(0).randn(n, elems).astype(np.float32),
+            NamedSharding(mesh, P("cal")),
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P("cal"), out_specs=P("cal")
+            )
+        )
+        carry = [x]
+
+        def step():
+            carry[0] = fn(carry[0])
+            return carry[0][0, 0]
+
+        return timed_differenced(step, steps, windows)[0]
+
+    def one_round(t):
+        return lax.ppermute(t, "cal", fwd)
+
+    def two_round(t):
+        return lax.ppermute(t, "cal", fwd) + lax.ppermute(t, "cal", bwd)
+
+    def two_round_chunked(t):
+        k = 4
+        per = max(
+            CHUNK_ALIGN_ELEMS,
+            (t.size // k) // CHUNK_ALIGN_ELEMS * CHUNK_ALIGN_ELEMS,
+        )
+        flat = t.reshape(-1)
+        parts = [flat[a:a + per] for a in range(0, flat.size, per)]
+        ys = [jnp.zeros_like(p) for p in parts]
+        rounds = (fwd, bwd)
+        for wave in range(len(rounds) + len(parts) - 1):
+            for c in range(len(parts)):
+                r = wave - c
+                if 0 <= r < len(rounds):
+                    ys[c] = ys[c] + lax.ppermute(parts[c], "cal", rounds[r])
+        return jnp.concatenate(ys).reshape(t.shape)
+
+    t_small = timed(one_round, small_elems)
+    t_large = timed(one_round, large_elems)
+    alpha = max(t_small, 1e-9)
+    dbytes = (large_elems - small_elems) * 4
+    beta = dbytes / max(t_large - t_small, 1e-9)
+
+    t_mono = timed(two_round, large_elems)
+    t_chunk = timed(two_round_chunked, large_elems)
+    # ideal wire ratio for R=2, k=4 at a latency-negligible payload:
+    # (R*k)/(R+k-1) = 8/5; measured gain below 1 means chunking HURT.
+    ideal_gain = (2 * 4) / (2 + 4 - 1)
+    gain = t_mono / max(t_chunk, 1e-9)
+    pipeline_eff = min(1.0, max(0.0, (gain - 1.0) / (ideal_gain - 1.0)))
+
+    _CAL = {
+        "alpha_s": float(alpha),
+        "beta_bytes_per_s": float(beta),
+        "pipeline_eff": float(pipeline_eff),
+        "source": "measured-probe",
+        "probe_devices": n,
+        "probe_gain_2round_4chunk": float(gain),
+    }
+    return dict(_CAL)
+
+
+def _maybe_autocalibrate() -> None:
+    if os.environ.get("BLUEFOG_PLAN_CALIBRATE", "0") in ("1", "true", "on"):
+        try:
+            calibrate()
+        except Exception:  # devices not up yet: keep class constants
+            pass
+
+
+# -- cost model --------------------------------------------------------------
+
+
+def round_cost_s(payload_bytes: float, congestion: float = 1.0) -> float:
+    """Cost of one ppermute round: fixed latency + payload transfer.
+    ``congestion`` is the round's max directed-link load under the route
+    model (:func:`bluefog_tpu.topology.placement.perm_congestion`) — L
+    transfers sharing a link serialize on it."""
+    cal = calibration()
+    return cal["alpha_s"] + congestion * payload_bytes / cal["beta_bytes_per_s"]
 
 
 def plan_cost_s(n_rounds: int, payload_bytes: float) -> float:
     """Rounds are sequential: plan cost = rounds x per-round cost."""
     return n_rounds * round_cost_s(payload_bytes)
+
+
+def pipelined_cost_s(
+    payload_bytes: float,
+    n_chunks: int,
+    congestions: Sequence[float],
+) -> float:
+    """Cost of a chunked wavefront schedule over rounds with the given
+    congestion factors.
+
+    ``k = 1`` is the serial plan: ``sum_r (alpha + L_r * B / beta)``.
+    For ``k > 1`` the ideal pipeline runs ``R + k - 1`` waves of
+    ``B / k``-byte stages (the bottleneck stage repeats ``k - 1``
+    times), discounted by the calibrated ``pipeline_eff``: a backend
+    that delivers no measured overlap prices chunking at the serial
+    cost plus its extra per-chunk launches, so the chooser never picks
+    what the fabric cannot deliver.
+    """
+    cal = calibration()
+    alpha, beta, gamma = (
+        cal["alpha_s"], cal["beta_bytes_per_s"], cal["pipeline_eff"]
+    )
+    k = max(1, int(n_chunks))
+    serial = sum(alpha + c * payload_bytes / beta for c in congestions)
+    if k == 1:
+        return serial
+    # a k-chunk schedule issues R*k ppermutes: with zero measured
+    # overlap every one of them pays its own launch, i.e. R*(k-1)
+    # alphas on top of the serial plan (not just k-1)
+    serial_k = serial + len(congestions) * (k - 1) * alpha
+    b = payload_bytes / k
+    bottleneck = alpha + max(congestions, default=1.0) * b / beta
+    ideal = sum(alpha + c * b / beta for c in congestions) + (
+        k - 1
+    ) * bottleneck
+    return gamma * ideal + (1.0 - gamma) * serial_k
 
 
 Perms = Tuple[Tuple[Tuple[int, int], ...], ...]
@@ -91,15 +348,29 @@ Perms = Tuple[Tuple[Tuple[int, int], ...], ...]
 @dataclasses.dataclass(frozen=True)
 class CompiledEdges:
     """The compiler's output for one edge set: the chosen round structure
-    plus the decision record kept on the plan for observability."""
+    plus the decision record kept on the plan for observability.
+
+    Direct decompositions (offset / coloring) leave ``inject`` and
+    ``delivery`` None: every perm pair is an original edge delivered in
+    its own round. The short-cut family fills them: ``inject[r]`` lists
+    the ranks sending their OWN payload in round ``r`` (all other
+    senders forward the transit value they received in round ``r-1``),
+    and ``delivery`` maps each original edge to the round whose receive
+    completes it — the round where the receiver's combine weight for
+    that edge applies.
+    """
 
     perms: Perms
-    method: str  # "offset" | "coloring" — the decomposition chosen
+    method: str  # "offset" | "coloring" | "shortcut" — decomposition chosen
     rounds: int
     offset_rounds: int  # the naive (offset-grouped) round count
     lower_bound: int  # König bound: max(max_in_degree, max_out_degree)
     predicted_cost_s: float
     offset_cost_s: float
+    route: str = "direct"  # "direct" | "shortcut"
+    inject: Optional[Tuple[Tuple[int, ...], ...]] = None
+    delivery: Optional[Tuple[Tuple[Tuple[int, int], int], ...]] = None
+    congestion: Tuple[float, ...] = ()
 
 
 def _canonical(edges: Iterable[Tuple[int, int]], size: int) -> Tuple[Tuple[int, int], ...]:
@@ -209,6 +480,141 @@ def coloring_perms(edges: Iterable[Tuple[int, int]], size: int) -> Perms:
     return perms
 
 
+def shortcut_perms(
+    edges: Iterable[Tuple[int, int]],
+    size: int,
+    dims: Optional[Sequence[int]] = None,
+) -> Tuple[Perms, Tuple[Tuple[int, ...], ...], Tuple[Tuple[Tuple[int, int], int], ...]]:
+    """Short-cut (relay) pass: decompose every edge into its unit-hop
+    route and schedule the hops over consecutive rounds.
+
+    Swing-style short-cutting for the gossip lowering: an edge between
+    virtual ranks that are far apart on the fabric
+    (:func:`bluefog_tpu.topology.placement.route_ranks` — the serpentine
+    ring by default, dimension-ordered torus moves under declared
+    ``BLUEFOG_TORUS_DIMS``) rides its whole route inside ONE ppermute
+    round, serializing on every link it crosses. Decomposed into a relay
+    chain — round ``r`` moves the value one hop, round ``r+1`` forwards
+    it one more — every round's transfers are physically-adjacent single
+    hops (congestion 1 by construction: a directed unit link determines
+    its sender, and a rank sends at most once per round), and chunk
+    pipelining (:func:`pipelined_cost_s`) hides the extra rounds at
+    large payloads. The value is moved verbatim (no arithmetic at
+    relays), so the receiver-side weight semantics are untouched: the
+    delivering round's weight applies exactly as the direct lowering's
+    would.
+
+    Scheduling is greedy earliest-start over chains sorted longest
+    first (deterministic): a chain occupies consecutive rounds (a relay
+    must forward what it received in the previous round — transit does
+    not persist), each rank sends <= 1 value per round (its own payload
+    when injecting, its transit otherwise) and receives <= 1.
+
+    Returns ``(perms, inject, delivery)``; validated by a host-side
+    relay simulation before returning.
+    """
+    edge_list = _canonical(edges, size)
+    chains = []
+    for u, v in edge_list:
+        ranks = _placement.route_ranks(u, v, size, dims)
+        chains.append(((u, v), tuple(zip(ranks[:-1], ranks[1:]))))
+    chains.sort(key=lambda c: (-len(c[1]), c[0]))
+
+    rounds: List[List[Tuple[int, int]]] = []
+    send_used: List[set] = []
+    recv_used: List[set] = []
+    inject: List[set] = []
+    delivery: List[Tuple[Tuple[int, int], int]] = []
+
+    def fits(start: int, hops) -> bool:
+        for t, (a, b) in enumerate(hops):
+            r = start + t
+            if r < len(rounds) and (a in send_used[r] or b in recv_used[r]):
+                return False
+        return True
+
+    for (u, v), hops in chains:
+        start = 0
+        while not fits(start, hops):
+            start += 1
+        for t, (a, b) in enumerate(hops):
+            r = start + t
+            while r >= len(rounds):
+                rounds.append([])
+                send_used.append(set())
+                recv_used.append(set())
+                inject.append(set())
+            rounds[r].append((a, b))
+            send_used[r].add(a)
+            recv_used[r].add(b)
+            if t == 0:
+                inject[r].add(a)
+        delivery.append(((u, v), start + len(hops) - 1))
+
+    perms = tuple(tuple(sorted(r)) for r in rounds)
+    inject_t = tuple(tuple(sorted(i)) for i in inject)
+    delivery_t = tuple(sorted(delivery))
+    _check_relay(perms, inject_t, delivery_t, edge_list, size)
+    return perms, inject_t, delivery_t
+
+
+def _check_relay(
+    perms: Perms,
+    inject: Tuple[Tuple[int, ...], ...],
+    delivery: Tuple[Tuple[Tuple[int, int], int], ...],
+    edge_list: Sequence[Tuple[int, int]],
+    size: int,
+) -> None:
+    """Invariant pass for relay schedules: partial permutation per round,
+    and a host-side simulation of the transit recursion proving every
+    declared delivery hands the receiver the ORIGINAL source's value —
+    the receiver-side-weights contract for short-cut plans."""
+    for perm in perms:
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        assert len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts), (
+            f"relay round is not a partial permutation: {perm}"
+        )
+    by_round: Dict[int, Dict[int, int]] = {}
+    transit: Dict[int, Optional[int]] = {}  # rank -> origin rank it carries
+    for r, perm in enumerate(perms):
+        arriving: Dict[int, Optional[int]] = {}
+        inj = set(inject[r])
+        for a, b in perm:
+            arriving[b] = a if a in inj else transit.get(a)
+        by_round[r] = {
+            b: o for b, o in arriving.items() if o is not None
+        }
+        transit = arriving
+    seen = []
+    for (u, v), r in delivery:
+        assert by_round.get(r, {}).get(v) == u, (
+            f"relay schedule does not deliver {u}->{v} at round {r}"
+        )
+        seen.append((u, v))
+    assert sorted(seen) == list(edge_list), (
+        "relay deliveries do not cover the edge set exactly"
+    )
+
+
+def _round_congestions(
+    perms: Perms, size: int, route: str = "direct"
+) -> Tuple[float, ...]:
+    """Per-round max-link-load factors for the cost model. Unit-hop relay
+    rounds are 1 by construction; direct rounds are priced by the route
+    model only when a physical fabric is declared (congestion on an
+    undeclared fabric would be a guess the measured chooser could not
+    honor)."""
+    if route == "shortcut":
+        return (1.0,) * len(perms)
+    dims = _placement.declared_torus_dims(size)
+    if dims is None:
+        return (1.0,) * len(perms)
+    return tuple(
+        float(_placement.perm_congestion(p, size, dims)) for p in perms
+    )
+
+
 def _check_rounds(perms: Perms, edge_list: Sequence[Tuple[int, int]]) -> None:
     """Invariant pass: every round is a partial permutation (each rank
     sends <= 1 and receives <= 1 — the receiver-side-weights contract)
@@ -242,20 +648,31 @@ def compile_edges(
 ) -> CompiledEdges:
     """Compile a directed edge set into ppermute rounds.
 
-    ``method``: ``"auto"`` (cost-modeled choice, the default),
-    ``"offset"`` (force the naive grouping) or ``"coloring"`` (force the
-    minimal coloring). Memoized on the canonical edge set, so repeated
-    lowerings of the same topology dedupe to one compile.
+    ``method``: ``"auto"`` (cost-modeled choice between offset grouping
+    and the minimal coloring, the default), ``"offset"`` (force the
+    naive grouping), ``"coloring"`` (force the minimal coloring) or
+    ``"shortcut"`` (the relay/bandwidth family: unit-hop routes, see
+    :func:`shortcut_perms`). Memoized on the canonical edge set, so
+    repeated lowerings of the same topology dedupe to one compile.
+
+    ``auto`` keeps the structure choice payload-independent (coloring
+    only on a strict round-count win, offset on ties — the circulant
+    ICI fast path stays byte-identical); the payload-dependent half of
+    the Pareto front — how many chunks to pipeline over the chosen
+    rounds — is decided at combine-lowering time by
+    :func:`choose_chunks`, where the actual wire payload is known.
     """
-    if method not in ("auto", "offset", "coloring"):
+    if method not in ("auto", "offset", "coloring", "shortcut"):
         raise ValueError(
-            f"method must be 'auto', 'offset' or 'coloring', got {method!r}"
+            "method must be 'auto', 'offset', 'coloring' or 'shortcut', "
+            f"got {method!r}"
         )
     from bluefog_tpu import metrics
 
     payload = DEFAULT_PAYLOAD_BYTES if payload_bytes is None else payload_bytes
     canon = _canonical(edges, size)
-    key = (canon, size, method, payload)
+    dims = _placement.declared_torus_dims(size)
+    key = (canon, size, method, payload, dims)
     hit = _COMPILE_CACHE.get(key)
     if hit is not None:
         metrics.counter("bluefog.plan_cache.hits").inc()
@@ -266,8 +683,13 @@ def compile_edges(
     bound = min_rounds(canon, size)
     offset_cost = plan_cost_s(len(naive), payload)
 
+    inject = delivery = None
+    route = "direct"
     if method == "offset":
         perms, chosen = naive, "offset"
+    elif method == "shortcut":
+        perms, inject, delivery = shortcut_perms(canon, size, dims)
+        chosen, route = "shortcut", "shortcut"
     else:
         colored = naive if len(naive) <= bound else coloring_perms(canon, size)
         assert len(colored) == bound or not canon, (
@@ -282,16 +704,91 @@ def compile_edges(
         else:
             perms, chosen = naive, "offset"
 
+    congestion = _round_congestions(perms, size, route)
     result = CompiledEdges(
         perms=perms,
         method=chosen,
         rounds=len(perms),
         offset_rounds=len(naive),
         lower_bound=bound,
-        predicted_cost_s=plan_cost_s(len(perms), payload),
+        predicted_cost_s=pipelined_cost_s(payload, 1, congestion),
         offset_cost_s=offset_cost,
+        route=route,
+        inject=inject,
+        delivery=delivery,
+        congestion=congestion,
     )
     if len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
         _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
     _COMPILE_CACHE[key] = result
     return result
+
+
+# -- the (rounds, chunks, route) Pareto chooser ------------------------------
+
+
+def chunk_option(
+    payload_bytes: float,
+    congestions: Sequence[float],
+    n_elems: Optional[int] = None,
+) -> Tuple[int, float]:
+    """Best chunk count and its predicted cost for one round structure:
+    argmin over powers of two of :func:`pipelined_cost_s`, capped so
+    every chunk keeps at least one 512-element scale group (``n_elems``
+    when known)."""
+    if not congestions:
+        return 1, 0.0
+    kmax = MAX_CHUNKS
+    if n_elems is not None:
+        kmax = min(kmax, max(1, int(n_elems) // CHUNK_ALIGN_ELEMS))
+    best_k, best_c = 1, pipelined_cost_s(payload_bytes, 1, congestions)
+    k = 2
+    while k <= kmax:
+        c = pipelined_cost_s(payload_bytes, k, congestions)
+        if c < best_c:
+            best_k, best_c = k, c
+        k *= 2
+    return best_k, best_c
+
+
+def choose_chunks(
+    compiled,
+    payload_bytes: float,
+    n_elems: Optional[int] = None,
+    method: str = "auto",
+) -> int:
+    """Per-payload chunk count for a compiled round structure — the
+    payload-dependent half of the latency×bandwidth Pareto front.
+
+    ``BLUEFOG_PLAN_CHUNKS`` (int >= 1) is the manual override; under
+    forced structure methods (``offset`` / ``coloring`` / ``shortcut``
+    without the env override) the chooser stays at 1 so A/B
+    measurements isolate one axis at a time. ``compiled`` is a
+    :class:`CompiledEdges` (its per-round congestion prices the space)
+    or a plain round count. With ``BLUEFOG_PLAN_CALIBRATE=1`` the
+    one-shot measured probe runs lazily before the first choice.
+    """
+    env = os.environ.get("BLUEFOG_PLAN_CHUNKS", "").strip()
+    if env:
+        try:
+            k = int(env)
+        except ValueError:
+            raise ValueError(
+                f"BLUEFOG_PLAN_CHUNKS must be a positive int, got {env!r}"
+            )
+        if k < 1:
+            raise ValueError(
+                f"BLUEFOG_PLAN_CHUNKS must be a positive int, got {env!r}"
+            )
+        if n_elems is not None:
+            k = min(k, max(1, int(n_elems) // CHUNK_ALIGN_ELEMS))
+        return k
+    if method not in (None, "auto"):
+        return 1
+    _maybe_autocalibrate()
+    if isinstance(compiled, CompiledEdges):
+        congestions = compiled.congestion or (1.0,) * compiled.rounds
+    else:
+        congestions = (1.0,) * int(compiled)
+    k, _cost = chunk_option(payload_bytes, congestions, n_elems)
+    return k

@@ -24,6 +24,7 @@ from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 __all__ = [
     "bucket_bytes_cap",
     "bucket_bounds",
+    "chunk_bounds",
     "weighted_combine",
     "weighted_combine_operands",
     "weighted_combine_quantized",
@@ -98,6 +99,126 @@ def bucket_bounds(
     return [(i, min(i + per, n_elems)) for i in range(0, n_elems, per)]
 
 
+def chunk_bounds(n_elems: int, chunks: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[start, end)`` splits of a flat payload into ~``chunks``
+    pipeline chunks, every boundary on the 512-element quantization grid
+    (the same alignment rule as :func:`bucket_bounds`, for the same
+    reason: a chunk boundary off the grid would regroup quantization
+    scale chunks and break the bitwise chunked==monolithic guarantee).
+    ``chunks <= 1`` or a sub-grid payload yields one chunk. The chunk
+    count the compiler's chooser requests is a cap — a payload too small
+    to honor it degrades to fewer (aligned) chunks.
+    """
+    k = max(1, int(chunks))
+    if k <= 1 or n_elems <= _QUANT_CHUNK:
+        return [(0, n_elems)]
+    per = -(-n_elems // k)
+    if per % _QUANT_CHUNK:
+        per += _QUANT_CHUNK - per % _QUANT_CHUNK
+    if per >= n_elems:
+        return [(0, n_elems)]
+    return [(i, min(i + per, n_elems)) for i in range(0, n_elems, per)]
+
+
+def _chunk_group_bounds(
+    bounds: List[Tuple[int, int]]
+) -> List[Tuple[int, int]]:
+    """Map element-space chunk bounds to quantization scale-GROUP bounds:
+    chunk ``[a, b)`` covers rows ``a // 512 .. ceil(b / 512)`` of the
+    full-width ``(q, scales)`` pair. Well-defined because
+    :func:`chunk_bounds` snaps every interior boundary to the grid —
+    slicing the full-width quantization per chunk is pure data movement,
+    never a regrouping of scale chunks."""
+    return [
+        (a // _QUANT_CHUNK, -(-b // _QUANT_CHUNK)) for a, b in bounds
+    ]
+
+
+def _wavefront(n_rounds: int, n_chunks: int):
+    """Issue order of the pipelined schedule: wave ``w`` holds every
+    (round ``r``, chunk ``c``) with ``r + c == w``, so chunk ``c`` of
+    round ``r`` is emitted alongside chunk ``c+1`` of round ``r-1`` —
+    the order that lets the latency-hiding scheduler overlap one
+    chunk's transfer with the previous chunk's next round."""
+    for wave in range(n_rounds + n_chunks - 1):
+        for c in range(n_chunks):
+            r = wave - c
+            if 0 <= r < n_rounds:
+                yield r, c
+
+
+def _inject_flags(inject, idx):
+    """Per-round traced booleans: does THIS rank send its own payload in
+    round r (True) or forward its transit value (False)? ``inject`` is
+    the static per-round rank tuple from the short-cut compiler."""
+    return [
+        jnp.isin(idx, jnp.asarray(inj, dtype=idx.dtype))
+        if inj else jnp.zeros((), bool)
+        for inj in inject
+    ]
+
+
+def _plan_inject(plan: CommPlan):
+    info = plan.compile_info
+    return info.inject if info is not None else None
+
+
+def _chunked_exact_combine(
+    xw: jnp.ndarray,
+    perms: Tuple[Tuple[Tuple[int, int], ...], ...],
+    inject,
+    self_scale: jnp.ndarray,
+    recv_scales: List[jnp.ndarray],
+    axis_name: str,
+    chunks: int,
+) -> jnp.ndarray:
+    """The generalized exact combine: chunked wavefront schedule with
+    optional relay (short-cut) rounds.
+
+    Only the TRANSFERS are chunked: the ppermutes are issued per
+    (round, chunk) in wavefront order — that is where the pipelining
+    lives — and each round's received chunks are concatenated back to
+    full width before the accumulate, so the arithmetic graph is
+    shape-identical to the monolithic lowering. That construction is
+    what makes chunked output bitwise-identical to unchunked for
+    arbitrary float inputs: slicing/concat is pure data movement, and
+    identical full-width accumulate chains compile to identical
+    rounding (per-chunk accumulates were observed to flip XLA:CPU's
+    FMA/factoring decisions at some buffer widths and break the last
+    ulp). Relay rounds forward the value a rank received in the
+    previous round (``transit``); ppermute delivers zeros to
+    non-destinations, so transit is only meaningful on scheduled
+    chains, and delivery rounds' receiver weights pick out exactly the
+    original sources' values (validated host-side by the compiler's
+    relay simulation).
+    """
+    idx = lax.axis_index(axis_name)
+    flat = xw.reshape(-1)
+    bounds = chunk_bounds(flat.size, chunks)
+    parts = [flat[a:b] for a, b in bounds]
+    n_rounds, n_chunks = len(perms), len(parts)
+    transit = (
+        [jnp.zeros_like(p) for p in parts] if inject is not None else None
+    )
+    flags = _inject_flags(inject, idx) if inject is not None else None
+    recv_parts: List[List] = [[None] * n_chunks for _ in range(n_rounds)]
+    for r, c in _wavefront(n_rounds, n_chunks):
+        if inject is None:
+            send = parts[c]
+        else:
+            send = jnp.where(flags[r], parts[c], transit[c])
+        recv = lax.ppermute(send, axis_name, perms[r])
+        if inject is not None:
+            transit[c] = recv
+        recv_parts[r][c] = recv
+    y = xw * self_scale
+    for r in range(n_rounds):
+        row = recv_parts[r]
+        recv_full = (row[0] if n_chunks == 1 else jnp.concatenate(row))
+        y = y + recv_full.reshape(xw.shape) * recv_scales[r]
+    return y
+
+
 def _weight_dtype(x: jnp.ndarray) -> jnp.dtype:
     """Averaging weights should not up-promote bf16 activations, but integer
     inputs must be averaged in float (the reference only ever averages float
@@ -105,7 +226,9 @@ def _weight_dtype(x: jnp.ndarray) -> jnp.dtype:
     return x.dtype if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.float32
 
 
-def weighted_combine(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndarray:
+def weighted_combine(
+    x: jnp.ndarray, plan: CommPlan, axis_name: str, chunks: int = 1
+) -> jnp.ndarray:
     """``y_j = self_w[j] * x_j + sum_r recv_w[r][j] * ppermute_r(x)_j``.
 
     One ``ppermute`` per plan round; receivers scale what they got by their
@@ -114,18 +237,35 @@ def weighted_combine(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndar
     whose weight entry is also zero, so irregular graphs need no masking.
     The round structure is whatever the plan compiler chose
     (:mod:`bluefog_tpu.collective.compiler`): offset-grouped circulant
-    rounds or the minimal edge coloring — both satisfy the only invariant
-    this combine relies on, that each rank receives from at most one
-    source per round.
+    rounds, the minimal edge coloring, or a short-cut relay schedule —
+    all satisfy the only invariant this combine relies on, that each
+    rank receives from at most one source per round.
+
+    ``chunks > 1`` splits the payload into 512-aligned chunks issued in
+    wavefront order (chunk ``c`` of round ``r`` alongside chunk ``c+1``
+    of round ``r-1``) — bitwise-identical output, pipelined wire; see
+    :func:`_chunked_exact_combine`. Short-cut plans (relay rounds in
+    ``plan.compile_info``) route through the same generalized core.
     """
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
-    y = xw * jnp.asarray(plan.self_weights, dtype=wdt)[idx]
-    for rnd in plan.rounds:
-        recv = lax.ppermute(xw, axis_name, rnd.perm)
-        y = y + recv * jnp.asarray(rnd.recv_weights, dtype=wdt)[idx]
-    return y
+    inject = _plan_inject(plan)
+    if chunks <= 1 and inject is None:
+        y = xw * jnp.asarray(plan.self_weights, dtype=wdt)[idx]
+        for rnd in plan.rounds:
+            recv = lax.ppermute(xw, axis_name, rnd.perm)
+            y = y + recv * jnp.asarray(rnd.recv_weights, dtype=wdt)[idx]
+        return y
+    return _chunked_exact_combine(
+        xw,
+        plan.perms,
+        inject,
+        jnp.asarray(plan.self_weights, dtype=wdt)[idx],
+        [jnp.asarray(r.recv_weights, dtype=wdt)[idx] for r in plan.rounds],
+        axis_name,
+        chunks,
+    )
 
 
 def weighted_combine_operands(
@@ -134,6 +274,8 @@ def weighted_combine_operands(
     self_w: jnp.ndarray,
     recv_w: jnp.ndarray,
     axis_name: str,
+    chunks: int = 1,
+    inject=None,
 ) -> jnp.ndarray:
     """:func:`weighted_combine` with the weights as runtime *operands*.
 
@@ -143,15 +285,27 @@ def weighted_combine_operands(
     program instead of compiling per weight vector (the reference swaps
     weights every iteration in its dynamic-topology idiom,
     README.rst:108-123 — the XLA analogue must not retrace for that).
+    ``chunks``/``inject`` select the pipelined / short-cut lowering
+    exactly as in :func:`weighted_combine`.
     """
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
-    y = xw * self_w[idx].astype(wdt)
-    for r, perm in enumerate(perms):
-        recv = lax.ppermute(xw, axis_name, perm)
-        y = y + recv * recv_w[r, idx].astype(wdt)
-    return y
+    if chunks <= 1 and inject is None:
+        y = xw * self_w[idx].astype(wdt)
+        for r, perm in enumerate(perms):
+            recv = lax.ppermute(xw, axis_name, perm)
+            y = y + recv * recv_w[r, idx].astype(wdt)
+        return y
+    return _chunked_exact_combine(
+        xw,
+        perms,
+        inject,
+        self_w[idx].astype(wdt),
+        [recv_w[r, idx].astype(wdt) for r in range(len(perms))],
+        axis_name,
+        chunks,
+    )
 
 
 def _check_combine_normalized(plan: CommPlan, what: str) -> None:
@@ -194,6 +348,7 @@ def weighted_combine_quantized_ef_operands(
     perms: Tuple[Tuple[Tuple[int, int], ...], ...],
     recv_w: jnp.ndarray,
     axis_name: str,
+    chunks: int = 1,
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Int8 wire with memory (CHOCO-style difference compression).
 
@@ -215,6 +370,14 @@ def weighted_combine_quantized_ef_operands(
     ``state = (x_hat_self [n], x_hat_recv [R, n])`` flat f32; returns
     ``(y, new_state)``. The caller owns the state (optimizer memory; the
     stateless eager facade exposes only the memoryless wires).
+
+    ``chunks > 1`` chunks only the TRANSFERS (512-aligned bounds, per-
+    chunk ppermutes in wavefront order); quantization, integration and
+    the accumulate all run at full width on the concatenated received
+    chunks, so the arithmetic graph — and therefore the trajectory
+    (output and both copies) — is bitwise the monolithic one. Short-cut
+    (relay) plans are refused upstream: the copies integrate a fixed
+    per-round source, which a relay round does not have.
     """
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
@@ -222,13 +385,50 @@ def weighted_combine_quantized_ef_operands(
     xhat_self, xhat_recv = state
     xf = xw.astype(jnp.float32).ravel()
     n = xf.size
+    bounds = chunk_bounds(n, chunks)
+    if len(bounds) == 1:
+        q, sc, dhat = _chunk_quantize(xf - xhat_self)
+        xhat_self_new = xhat_self + dhat
+        y = xw
+        new_recv = []
+        for r, perm in enumerate(perms):
+            recv_q = lax.ppermute(q, axis_name, perm)
+            recv_s = lax.ppermute(sc, axis_name, perm)
+            recv_dhat = (
+                recv_q.astype(jnp.float32) * recv_s[:, None]
+            ).reshape(-1)[:n]
+            hat_r = xhat_recv[r] + recv_dhat
+            new_recv.append(hat_r)
+            y = y + (
+                (hat_r - xhat_self_new).reshape(x.shape).astype(wdt)
+                * recv_w[r, idx].astype(wdt)
+            )
+        return y, (xhat_self_new, jnp.stack(new_recv))
+
+    # chunked wavefront: only the TRANSFERS are chunked. Quantize once at
+    # full width (chunk bounds sit on the scale grid, so per-chunk wire
+    # slices are whole scale groups) and slice (q, scales) per chunk for
+    # the ppermutes; each round's received chunks concatenate back to
+    # full width BEFORE the dequantize/integrate/accumulate, so the
+    # arithmetic graph is shape-identical to the monolithic branch above
+    # — the same construction (and reason) as _chunked_exact_combine:
+    # per-chunk accumulates can flip XLA:CPU FMA/factoring decisions at
+    # some buffer widths and break the bitwise chunked==monolithic pin.
+    R, C = len(perms), len(bounds)
     q, sc, dhat = _chunk_quantize(xf - xhat_self)
     xhat_self_new = xhat_self + dhat
+    groups = _chunk_group_bounds(bounds)
+    recv_qs = [[None] * C for _ in range(R)]
+    recv_ss = [[None] * C for _ in range(R)]
+    for r, c in _wavefront(R, C):
+        ga, gb = groups[c]
+        recv_qs[r][c] = lax.ppermute(q[ga:gb], axis_name, perms[r])
+        recv_ss[r][c] = lax.ppermute(sc[ga:gb], axis_name, perms[r])
     y = xw
     new_recv = []
-    for r, perm in enumerate(perms):
-        recv_q = lax.ppermute(q, axis_name, perm)
-        recv_s = lax.ppermute(sc, axis_name, perm)
+    for r in range(R):
+        recv_q = jnp.concatenate(recv_qs[r])
+        recv_s = jnp.concatenate(recv_ss[r])
         recv_dhat = (
             recv_q.astype(jnp.float32) * recv_s[:, None]
         ).reshape(-1)[:n]
@@ -247,6 +447,8 @@ def weighted_combine_quantized_operands(
     recv_w: jnp.ndarray,
     axis_name: str,
     wire: str = "int8",
+    chunks: int = 1,
+    inject=None,
 ) -> jnp.ndarray:
     """Int8-quantized-wire combine; weights are runtime operands (keyed on
     the edge structure only, like :func:`weighted_combine_operands`, so
@@ -277,6 +479,8 @@ def weighted_combine_quantized_operands(
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
+    R = len(perms)
+    flags = _inject_flags(inject, idx) if inject is not None else None
 
     if wire == "bf16":
         # 2x fewer bytes, ~3 decimal digits kept, no scales needed; the
@@ -287,11 +491,51 @@ def weighted_combine_quantized_operands(
         # The difference arithmetic runs in f32: dequantizing INTO fp16
         # would overflow near the fp16 max (bf16 rounds 65504 up to
         # 65536 = inf in fp16) even when all workers agree.
-        q16 = lax.optimization_barrier(xw.astype(jnp.bfloat16))
-        xhat_f = q16.astype(jnp.float32)
+        if chunks <= 1 and inject is None:
+            q16 = lax.optimization_barrier(xw.astype(jnp.bfloat16))
+            xhat_f = q16.astype(jnp.float32)
+            y = xw
+            for r, perm in enumerate(perms):
+                recv_f = lax.ppermute(q16, axis_name, perm).astype(
+                    jnp.float32
+                )
+                y = y + (
+                    (recv_f - xhat_f) * recv_w[r, idx].astype(jnp.float32)
+                ).astype(wdt)
+            return y
+        # chunked / relay form: only the TRANSFERS are chunked (slicing
+        # the bf16 payload is pure data movement); each round's received
+        # chunks concatenate back to full width before the difference
+        # accumulate, so the arithmetic graph is shape-identical to the
+        # monolithic branch — per-chunk accumulates can flip XLA:CPU
+        # FMA/factoring decisions at some buffer widths and break the
+        # bitwise chunked==monolithic pin (see _chunked_exact_combine)
+        q16_full = lax.optimization_barrier(
+            xw.reshape(-1).astype(jnp.bfloat16)
+        )
+        bounds = chunk_bounds(q16_full.size, chunks)
+        q16s = [q16_full[a:b] for a, b in bounds]
+        xhat_f = q16_full.astype(jnp.float32).reshape(x.shape)
+        C = len(q16s)
+        transit = (
+            [jnp.zeros_like(q) for q in q16s] if inject is not None else None
+        )
+        recv_parts = [[None] * C for _ in range(R)]
+        for r, c in _wavefront(R, C):
+            send = (
+                q16s[c] if inject is None
+                else jnp.where(flags[r], q16s[c], transit[c])
+            )
+            recv = lax.ppermute(send, axis_name, perms[r])
+            if inject is not None:
+                transit[c] = recv
+            recv_parts[r][c] = recv
         y = xw
-        for r, perm in enumerate(perms):
-            recv_f = lax.ppermute(q16, axis_name, perm).astype(jnp.float32)
+        for r in range(R):
+            row = recv_parts[r]
+            recv_f = (
+                row[0] if C == 1 else jnp.concatenate(row)
+            ).astype(jnp.float32).reshape(x.shape)
             y = y + (
                 (recv_f - xhat_f) * recv_w[r, idx].astype(jnp.float32)
             ).astype(wdt)
@@ -299,36 +543,88 @@ def weighted_combine_quantized_operands(
 
     xf = xw.astype(jnp.float32)
     n = xf.size
+    if chunks <= 1 and inject is None:
+        q, s, xhat_flat = _chunk_quantize(xf.ravel())
+
+        def dequant(qq, ss):
+            full = (qq.astype(jnp.float32) * ss[:, None]).reshape(-1)[:n]
+            return full.reshape(x.shape).astype(wdt)
+
+        xhat_self = xhat_flat.reshape(x.shape).astype(wdt)
+        y = xw
+        for r, perm in enumerate(perms):
+            recv_q = lax.ppermute(q, axis_name, perm)
+            recv_s = lax.ppermute(s, axis_name, perm)
+            y = y + (dequant(recv_q, recv_s) - xhat_self) * recv_w[
+                r, idx
+            ].astype(wdt)
+        return y
+
+    # chunked / relay int8: only the TRANSFERS are chunked — quantize
+    # once at full width (bounds snap to the 512-element scale grid, so
+    # per-chunk wire slices are whole scale groups), ship per-chunk
+    # (q, scales) slices, and concatenate each round's received chunks
+    # back to full width before the dequantize + accumulate, keeping the
+    # arithmetic graph shape-identical to the monolithic branch (see
+    # _chunked_exact_combine for why per-chunk accumulates are unsafe).
+    # Relay rounds forward the (q, scales) pair verbatim; arithmetic
+    # only happens at deliveries.
+    bounds = chunk_bounds(n, chunks)
     q, s, xhat_flat = _chunk_quantize(xf.ravel())
-
-    def dequant(qq, ss):
-        full = (qq.astype(jnp.float32) * ss[:, None]).reshape(-1)[:n]
-        return full.reshape(x.shape).astype(wdt)
-
     xhat_self = xhat_flat.reshape(x.shape).astype(wdt)
+    groups = _chunk_group_bounds(bounds)
+    qs = [q[ga:gb] for ga, gb in groups]
+    ss = [s[ga:gb] for ga, gb in groups]
+    C = len(bounds)
+    transit = (
+        [(jnp.zeros_like(qc), jnp.zeros_like(sc)) for qc, sc in zip(qs, ss)]
+        if inject is not None else None
+    )
+    recv_qs = [[None] * C for _ in range(R)]
+    recv_ss = [[None] * C for _ in range(R)]
+    for r, c in _wavefront(R, C):
+        if inject is None:
+            send_q, send_s = qs[c], ss[c]
+        else:
+            send_q = jnp.where(flags[r], qs[c], transit[c][0])
+            send_s = jnp.where(flags[r], ss[c], transit[c][1])
+        recv_q = lax.ppermute(send_q, axis_name, perms[r])
+        recv_s = lax.ppermute(send_s, axis_name, perms[r])
+        if inject is not None:
+            transit[c] = (recv_q, recv_s)
+        recv_qs[r][c] = recv_q
+        recv_ss[r][c] = recv_s
     y = xw
-    for r, perm in enumerate(perms):
-        recv_q = lax.ppermute(q, axis_name, perm)
-        recv_s = lax.ppermute(s, axis_name, perm)
-        y = y + (dequant(recv_q, recv_s) - xhat_self) * recv_w[
-            r, idx
-        ].astype(wdt)
+    for r in range(R):
+        recv_q = recv_qs[r][0] if C == 1 else jnp.concatenate(recv_qs[r])
+        recv_s = recv_ss[r][0] if C == 1 else jnp.concatenate(recv_ss[r])
+        deq = (
+            recv_q.astype(jnp.float32) * recv_s[:, None]
+        ).reshape(-1)[:n].reshape(x.shape).astype(wdt)
+        y = y + (deq - xhat_self) * recv_w[r, idx].astype(wdt)
     return y
 
 
 def weighted_combine_quantized(
-    x: jnp.ndarray, plan: CommPlan, axis_name: str, wire: str = "int8"
+    x: jnp.ndarray,
+    plan: CommPlan,
+    axis_name: str,
+    wire: str = "int8",
+    chunks: int = 1,
 ) -> jnp.ndarray:
     """:func:`weighted_combine_quantized_operands` with the plan's static
     weights; validates the plan is normalized."""
     _check_combine_normalized(plan, f"compression={wire!r}")
     _self_w, recv_w = plan.weight_operands()
     return weighted_combine_quantized_operands(
-        x, plan.perms, jnp.asarray(recv_w), axis_name, wire=wire
+        x, plan.perms, jnp.asarray(recv_w), axis_name, wire=wire,
+        chunks=chunks, inject=_plan_inject(plan),
     )
 
 
-def neighbor_allreduce(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.ndarray:
+def neighbor_allreduce(
+    x: jnp.ndarray, plan: CommPlan, axis_name: str, chunks: int = 1
+) -> jnp.ndarray:
     """Weighted neighbor averaging over a static topology plan.
 
     TPU-native form of reference ``neighbor_allreduce``
@@ -336,7 +632,7 @@ def neighbor_allreduce(x: jnp.ndarray, plan: CommPlan, axis_name: str) -> jnp.nd
     the graph-communicator exchange is the plan's ppermute rounds and the
     combine is in-program.
     """
-    return weighted_combine(x, plan, axis_name)
+    return weighted_combine(x, plan, axis_name, chunks=chunks)
 
 
 def neighbor_allreduce_step(
@@ -372,7 +668,23 @@ def neighbor_allgather(
     off per rank.
     """
     idx = lax.axis_index(axis_name)
-    received = [lax.ppermute(x, axis_name, rnd.perm) for rnd in plan.rounds]
+    inject = _plan_inject(plan)
+    if inject is None:
+        received = [
+            lax.ppermute(x, axis_name, rnd.perm) for rnd in plan.rounds
+        ]
+    else:
+        # short-cut plan: the per-round receive is the relay transit;
+        # gather_slots points every (receiver, source) pair at its
+        # DELIVERY round, where the transit holds the original value
+        flags = _inject_flags(inject, idx)
+        received = []
+        transit = jnp.zeros_like(x)
+        for r, rnd in enumerate(plan.rounds):
+            send = jnp.where(flags[r], x, transit)
+            recv = lax.ppermute(send, axis_name, rnd.perm)
+            transit = recv
+            received.append(recv)
     if not received:
         empty = jnp.zeros((0,) + x.shape, dtype=x.dtype)
         return empty, jnp.zeros((0,), dtype=bool)

@@ -32,7 +32,7 @@ from bluefog_tpu import flight
 from bluefog_tpu import metrics
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
-from bluefog_tpu.collective import inner
+from bluefog_tpu.collective import compiler, inner
 from bluefog_tpu.collective.plan import (
     CommPlan,
     plan_from_topology,
@@ -212,10 +212,38 @@ def _reject_flat_weight_dict(arg_name, value):
 
 def _plan_method() -> str:
     """Decomposition override for the comm-plan compiler: ``auto`` (the
-    cost-modeled default), ``offset`` or ``coloring`` — an A/B knob for
-    measuring the round-packing optimizer against the naive lowering
-    (see docs/plan_compiler.md)."""
+    cost-modeled default), ``offset``, ``coloring`` or ``shortcut`` — an
+    A/B knob for measuring the round-packing optimizer and the
+    bandwidth (relay) family against the naive lowering (see
+    docs/plan_compiler.md). Validation happens in
+    :func:`bluefog_tpu.collective.compiler.compile_edges`."""
     return os.environ.get("BLUEFOG_PLAN_METHOD", "auto")
+
+
+_WIRE_ITEMSIZE = {"int8": 1, "int8_ef": 1, "bf16": 2}
+
+
+def _plan_chunks(plan: CommPlan, x, compression=None) -> int:
+    """Per-dispatch chunk count for the eager combine: the compiler's
+    Pareto chooser over this call's actual per-worker WIRE payload (x is
+    a worker array; row 0's elements are what one rank ships per round,
+    at the compressed wire width when a quantized wire is active — the
+    latency/bandwidth crossover moves with the bytes on the wire, not
+    the uncompressed input). ``BLUEFOG_PLAN_CHUNKS`` overrides; forced
+    (non-auto) plan methods pin 1 so A/B runs isolate one axis (see
+    compiler.choose_chunks)."""
+    n_elems = 1
+    for d in x.shape[1:]:
+        n_elems *= int(d)
+    itemsize = _WIRE_ITEMSIZE.get(compression, jnp.dtype(x.dtype).itemsize)
+    payload = n_elems * itemsize
+    compiled = plan.compile_info
+    return compiler.choose_chunks(
+        compiled if compiled is not None else len(plan.rounds),
+        payload,
+        n_elems=n_elems,
+        method=_plan_method(),
+    )
 
 
 def _static_plan(ctx) -> CommPlan:
@@ -375,19 +403,22 @@ def broadcast(x, root_rank: int, name: Optional[str] = None):
 # -- neighbor collectives ----------------------------------------------------
 
 
-def _combine_for(compression):
+def _combine_for(compression, chunks: int = 1):
     """Validate the compression knob and return the matching combine body
     (shared by the eager facade and the torch frontend, so the validation
-    and wire selection cannot drift apart)."""
+    and wire selection cannot drift apart). ``chunks`` is the pipelined
+    chunk count the plan chooser picked for this payload."""
     if compression not in (None, "int8", "bf16"):
         raise ValueError(
             "compression must be None, 'int8', or 'bf16', got "
             f"{compression!r}"
         )
     if compression is None:
-        return inner.neighbor_allreduce
+        return lambda xb, pl_, ax: inner.neighbor_allreduce(
+            xb, pl_, ax, chunks=chunks
+        )
     return lambda xb, pl_, ax: inner.weighted_combine_quantized(
-        xb, pl_, ax, wire=compression
+        xb, pl_, ax, wire=compression, chunks=chunks
     )
 
 
@@ -404,9 +435,17 @@ def neighbor_allreduce_nonblocking(
     ctx = ctx_mod.get_context()
     x = _check_worker_array(ctx, x)
     plan = _resolve_plan(ctx, self_weight, src_weights, dst_weights, enable_topo_check)
-    combine = _combine_for(compression)
+    # chunk count and route family join the cache key: a chunk-count (or
+    # BLUEFOG_PLAN_CHUNKS / BLUEFOG_TORUS_DIMS) change must compile its
+    # own program, never reuse a structurally different lowering
+    chunks = _plan_chunks(plan, x, compression)
+    route = (
+        plan.compile_info.route if plan.compile_info is not None else "direct"
+    )
+    combine = _combine_for(compression, chunks)
     fn = _compiled(
-        ctx, "neighbor_allreduce", (plan, compression) + _aval_key(x),
+        ctx, "neighbor_allreduce",
+        (plan, compression, chunks, route) + _aval_key(x),
         lambda xb: combine(xb, plan, ctx_mod.WORKER_AXIS),
         in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
     )

@@ -115,22 +115,35 @@ class CommPlan:
             recv[r] = rnd.recv_weights
         return self_w, recv
 
+    def _edge_rounds(self) -> List[Tuple[int, int, int]]:
+        """``(src, dst, delivering_round)`` for every LOGICAL edge. Direct
+        plans deliver each perm pair in its own round; short-cut plans
+        (relay rounds in ``compile_info``) deliver an edge at the round
+        its chain completes, recorded by the compiler — relay pairs are
+        transport, not neighbor relations."""
+        info = self.compile_info
+        if info is not None and info.delivery is not None:
+            return [(s, d, r) for (s, d), r in info.delivery]
+        return [
+            (s, d, r)
+            for r, rnd in enumerate(self.rounds)
+            for s, d in rnd.perm
+        ]
+
     @functools.cached_property
     def in_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
         """Sorted in-neighbor list per rank (ascending, reference order —
         reference tests check neighbor_allgather output is rank-ordered)."""
         ins: List[List[int]] = [[] for _ in range(self.size)]
-        for rnd in self.rounds:
-            for s, d in rnd.perm:
-                ins[d].append(s)
+        for s, d, _r in self._edge_rounds():
+            ins[d].append(s)
         return tuple(tuple(sorted(lst)) for lst in ins)
 
     @functools.cached_property
     def out_neighbors(self) -> Tuple[Tuple[int, ...], ...]:
         outs: List[List[int]] = [[] for _ in range(self.size)]
-        for rnd in self.rounds:
-            for s, d in rnd.perm:
-                outs[s].append(d)
+        for s, d, _r in self._edge_rounds():
+            outs[s].append(d)
         return tuple(tuple(sorted(lst)) for lst in outs)
 
     @property
@@ -143,9 +156,8 @@ class CommPlan:
         in-neighbors. Used by neighbor_allgather to reorder round-stacked
         receives into the reference's rank-ordered layout."""
         src_round: List[Dict[int, int]] = [dict() for _ in range(self.size)]
-        for r, rnd in enumerate(self.rounds):
-            for s, d in rnd.perm:
-                src_round[d][s] = r
+        for s, d, r in self._edge_rounds():
+            src_round[d][s] = r
         out = np.full((self.size, max(self.max_in_degree, 1)), -1, np.int32)
         for j, srcs in enumerate(self.in_neighbors):
             for k, s in enumerate(srcs):
@@ -172,9 +184,8 @@ class CommPlan:
         w = np.zeros((self.size, self.size))
         for j in range(self.size):
             w[j, j] = self.self_weights[j]
-        for rnd in self.rounds:
-            for s, d in rnd.perm:
-                w[s, d] = rnd.recv_weights[d]
+        for s, d, r in self._edge_rounds():
+            w[s, d] = self.rounds[r].recv_weights[d]
         return w
 
 
@@ -211,7 +222,15 @@ def perms_from_edges(
     the window subsystem). Delegates to the pass pipeline in
     :mod:`bluefog_tpu.collective.compiler`: offset grouping, minimal
     edge-coloring, and the cost-modeled choice between them (``method``
-    forces one pass for A/B measurement)."""
+    forces one pass for A/B measurement).
+
+    Short-cut relay schedules are NOT expressible as bare perms (their
+    rounds carry transit, not per-round deliveries), so callers of this
+    structure-only surface — the window subsystem's put/get lowering —
+    get the direct ``auto`` decomposition when the method asks for
+    ``shortcut``."""
+    if method == "shortcut":
+        method = "auto"
     return compiler.compile_edges(edges, size, method=method).perms
 
 
@@ -239,11 +258,23 @@ def plan_from_matrix(
         edges = zip(*np.nonzero(w))
     compiled = compiler.compile_edges(edges, size, method=method)
     rounds = []
-    for perm in compiled.perms:
-        weights = [0.0] * size
-        for s, d in perm:
-            weights[d] = float(w[s, d])
-        rounds.append(CommRound(perm=perm, recv_weights=tuple(weights)))
+    if compiled.delivery is not None:
+        # short-cut lowering: an edge's weight applies at the round its
+        # relay chain DELIVERS (the perm pair there names the relay, not
+        # the origin — the compiler's delivery table is the edge map)
+        per_round = [[0.0] * size for _ in compiled.perms]
+        for (s, d), r in compiled.delivery:
+            per_round[r][d] = float(w[s, d])
+        rounds = [
+            CommRound(perm=perm, recv_weights=tuple(per_round[r]))
+            for r, perm in enumerate(compiled.perms)
+        ]
+    else:
+        for perm in compiled.perms:
+            weights = [0.0] * size
+            for s, d in perm:
+                weights[d] = float(w[s, d])
+            rounds.append(CommRound(perm=perm, recv_weights=tuple(weights)))
 
     return CommPlan(
         size=size,

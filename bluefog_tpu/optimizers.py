@@ -59,7 +59,7 @@ from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
-from bluefog_tpu.collective import inner, ops as col_ops
+from bluefog_tpu.collective import compiler, inner, ops as col_ops
 from bluefog_tpu.collective.plan import SchedulePlan, plan_from_topology
 from jax.sharding import PartitionSpec as P
 
@@ -466,7 +466,46 @@ class _GossipOptimizer:
 
     # -- gossip resolution ---------------------------------------------------
 
-    def _gossip_key_and_fn(self, ctx):
+    def _wire_payload(self, params):
+        """``(payload_bytes, n_elems)`` of the largest wire bucket this
+        dispatch ships — the payload the compiler's chunk chooser prices
+        (PR-2 buckets are the chunking grain: each bucket is split into
+        the chosen chunk count inside the combine)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        cap = inner.bucket_bytes_cap()
+        best = None
+        for dt, idxs in _dtype_groups(leaves):
+            n = sum(int(np.prod(leaves[i].shape[1:])) for i in idxs)
+            if n == 0:
+                continue
+            itemsize = np.dtype(dt).itemsize
+            bounds = inner.bucket_bounds(n, itemsize, cap)
+            elems = max(b - a for a, b in bounds)
+            if best is None or elems * itemsize > best[0]:
+                best = (elems * itemsize, elems)
+        return best
+
+    def _plan_chunks(self, plan, payload) -> int:
+        """The (rounds, chunks, route) Pareto chooser for one static-plan
+        dispatch; 1 when no payload is known (keying callers that never
+        dispatch, e.g. structural tests). A quantized wire ships fewer
+        bytes per element than the bucket's storage dtype — the chooser
+        prices the wire payload, not the uncompressed input."""
+        if payload is None:
+            return 1
+        payload_bytes, n_elems = payload
+        wire_itemsize = col_ops._WIRE_ITEMSIZE.get(self.compression)
+        if wire_itemsize is not None:
+            payload_bytes = n_elems * wire_itemsize
+        compiled = plan.compile_info
+        return compiler.choose_chunks(
+            compiled if compiled is not None else len(plan.rounds),
+            payload_bytes,
+            n_elems=n_elems,
+            method=col_ops._plan_method(),
+        )
+
+    def _gossip_key_and_fn(self, ctx, payload=None):
         """Resolve the communication into (cache-key piece, block fn,
         weight operands).
 
@@ -475,6 +514,12 @@ class _GossipOptimizer:
         operands, so the reference's per-iteration weight-reassignment
         idiom (README.rst:108-123) reuses ONE compiled program per edge
         structure instead of compiling per weight vector.
+
+        ``payload`` is ``(bytes, elems)`` of the largest wire bucket
+        (:meth:`_wire_payload`); the static-plan neighbor_allreduce
+        paths feed it to the chunk chooser, and the chosen chunk count
+        plus the plan's route family join the cache-key piece — a
+        chunk/route change compiles its own program.
         """
         comm = self.communication_type
         if self.schedule is not None and comm not in (
@@ -523,6 +568,9 @@ class _GossipOptimizer:
                 self.enable_topo_check,
             )
             perms = plan.perms
+            info = plan.compile_info
+            inject = info.inject if info is not None else None
+            chunks = self._plan_chunks(plan, payload)
             self_w, recv_w = plan.weight_operands()
             if self.compression is not None:
                 inner._check_combine_normalized(
@@ -533,30 +581,40 @@ class _GossipOptimizer:
                 # same guarantee as the exact path
                 wire = self.compression
                 if wire == "int8_ef":
+                    if inject is not None:
+                        raise ValueError(
+                            "compression='int8_ef' cannot ride a "
+                            "short-cut (relay) plan: the CHOCO copies "
+                            "integrate a fixed per-round source, which "
+                            "relay rounds do not have. Unset "
+                            "BLUEFOG_PLAN_METHOD=shortcut or use "
+                            "compression in (None, 'int8', 'bf16')."
+                        )
                     return (
-                        ("na_q_ef", perms),
+                        ("na_q_ef", perms, chunks),
                         lambda flat, e, wops: (
                             inner.weighted_combine_quantized_ef_operands(
                                 flat, e, perms, wops[0],
-                                ctx_mod.WORKER_AXIS,
+                                ctx_mod.WORKER_AXIS, chunks=chunks,
                             )
                         ),
                         (jnp.asarray(recv_w),),
                     )
                 return (
-                    ("na_q", wire, perms),
+                    ("na_q", wire, perms, chunks, inject),
                     lambda t, step, wops: (
                         inner.weighted_combine_quantized_operands(
                             t, perms, wops[0], ctx_mod.WORKER_AXIS,
-                            wire=wire,
+                            wire=wire, chunks=chunks, inject=inject,
                         )
                     ),
                     (jnp.asarray(recv_w),),
                 )
             return (
-                ("na", perms),
+                ("na", perms, chunks, inject),
                 lambda t, step, wops: inner.weighted_combine_operands(
-                    t, perms, wops[0], wops[1], ctx_mod.WORKER_AXIS
+                    t, perms, wops[0], wops[1], ctx_mod.WORKER_AXIS,
+                    chunks=chunks, inject=inject,
                 ),
                 (jnp.asarray(self_w), jnp.asarray(recv_w)),
             )
@@ -803,7 +861,9 @@ class _GossipOptimizer:
         elif hier:
             gossip_key, gossip_fn, wops = self._hier_key_and_fn(ctx)
         else:
-            gossip_key, gossip_fn, wops = self._gossip_key_and_fn(ctx)
+            gossip_key, gossip_fn, wops = self._gossip_key_and_fn(
+                ctx, self._wire_payload(params)
+            )
         ef = comm_now and not hier and self.compression == "int8_ef"
         if ef:
             self._ensure_ef_state(ctx, params, spec, gossip_key[1])
@@ -872,8 +932,13 @@ class _GossipOptimizer:
             tag = gossip_key[0]
             wire = None
             rounds = 0
+            # gossip_key layouts: ("na", perms, chunks, inject),
+            # ("na_q", wire, perms, chunks, inject),
+            # ("na_q_ef", perms, chunks), ("hier", perms),
+            # ("hier_q", wire, perms) — perms sits at [1] except the
+            # wire-tagged quantized keys where it sits at [2]
             if tag in ("na", "na_q_ef", "hier"):
-                rounds = len(gossip_key[-1])
+                rounds = len(gossip_key[1])
                 wire = "int8_ef" if tag == "na_q_ef" else None
             elif tag in ("na_q", "hier_q"):
                 wire = gossip_key[1]

@@ -42,11 +42,16 @@ from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 
 # Alpha-beta wire-model constants shared with the comm-plan compiler's
 # cost model (bluefog_tpu.collective.compiler): per-round fixed latency
-# plus payload/bandwidth over an ICI link.
+# plus payload/bandwidth over an ICI link — the class defaults that the
+# compiler's one-shot measured probe (compiler.calibrate) replaces at
+# runtime; pipelined_cost_s / calibration are re-exported with them so
+# analytic accounting and the chunk chooser can never disagree.
 from bluefog_tpu.collective.compiler import (  # noqa: F401  (re-export)
     ROUND_ALPHA_S,
     ICI_LINK_BYTES_PER_S,
     plan_cost_s,
+    pipelined_cost_s,
+    calibration,
 )
 
 __all__ = [
@@ -59,6 +64,8 @@ __all__ = [
     "ROUND_ALPHA_S",
     "ICI_LINK_BYTES_PER_S",
     "plan_cost_s",
+    "pipelined_cost_s",
+    "calibration",
 ]
 
 _DTYPE_BYTES = {
@@ -152,19 +159,34 @@ def _mesh(n: int) -> Mesh:
 
 def plan_comm_summary(plan: CommPlan, payload_bytes: int) -> Dict[str, object]:
     """Per-plan round/byte accounting: the compiler's decomposition
-    decision, naive-vs-chosen round counts, the König lower bound, and the
-    alpha-beta predicted step cost for a given gossip payload."""
+    decision, naive-vs-chosen round counts, the König lower bound, the
+    alpha-beta predicted step cost for a given gossip payload, and the
+    bandwidth-family record (route, modeled congestion, the chunk count
+    the Pareto chooser would pipeline at this payload with its predicted
+    cost)."""
+    from bluefog_tpu.collective import compiler as _compiler
+
     info = plan.compile_info
     rounds = len(plan.rounds)
     naive_rounds = info.offset_rounds if info else rounds
+    congestion = (
+        info.congestion if info and info.congestion else (1.0,) * rounds
+    )
+    auto_chunks, chunked_cost = _compiler.chunk_option(
+        payload_bytes, congestion, n_elems=payload_bytes // 4
+    )
     return {
         "rounds": rounds,
         "decomposition": info.method if info else "offset",
+        "route": info.route if info else "direct",
         "naive_rounds": naive_rounds,
         "lower_bound": info.lower_bound if info else rounds,
         "wire_bytes_per_round": payload_bytes,
+        "max_congestion": max(congestion, default=1.0),
         "predicted_cost_us": plan_cost_s(rounds, payload_bytes) * 1e6,
         "naive_cost_us": plan_cost_s(naive_rounds, payload_bytes) * 1e6,
+        "auto_chunks": auto_chunks,
+        "chunked_cost_us": chunked_cost * 1e6,
     }
 
 

@@ -23,11 +23,20 @@ XLA lowers ``ppermute`` on its own; this placement only fixes the
 device-order input to ``Mesh`` so the permutes it emits are torus-friendly.
 """
 
-from typing import List, Optional, Sequence
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["serpentine_device_order", "worker_device_order"]
+__all__ = [
+    "serpentine_device_order",
+    "worker_device_order",
+    "declared_torus_dims",
+    "serpentine_positions",
+    "route_ranks",
+    "hop_distance",
+    "perm_congestion",
+]
 
 
 def serpentine_device_order(devices: Sequence) -> List:
@@ -83,3 +92,146 @@ def worker_device_order(devices: Optional[Sequence] = None) -> List:
 
         devices = jax.devices()
     return serpentine_device_order(devices)
+
+
+# -- virtual-fabric routing model (used by the comm-plan compiler) -----------
+#
+# The compiler's bandwidth families (shortcut routes, per-round link
+# congestion) need to know which virtual-rank pairs are physically
+# adjacent. Under the serpentine placement above, consecutive virtual
+# ranks ARE physically adjacent, so the 1-D ring of virtual ranks is the
+# always-available fabric model; a declared torus (`BLUEFOG_TORUS_DIMS`,
+# matching the slice the serpentine walk was laid onto) refines it to
+# dimension-ordered unit moves in the same coordinate space the walk
+# produced. These are host-side model functions — nothing here touches
+# devices.
+
+
+def declared_torus_dims(size: int) -> Optional[Tuple[int, ...]]:
+    """The declared physical fabric for ``size`` ranks, or None.
+
+    ``BLUEFOG_TORUS_DIMS`` names the torus the serpentine order was laid
+    onto, e.g. ``4,4`` / ``4x8`` / ``16`` (a single dim = the 1-D ring).
+    Dims that do not multiply to ``size`` are ignored (a topology half
+    the slice, a CPU test mesh) — the congestion/route model then stays
+    conservative (no fabric ⇒ every round is modeled congestion-free and
+    shortcut routes fall back to the virtual ring).
+    """
+    raw = os.environ.get("BLUEFOG_TORUS_DIMS", "").strip()
+    if not raw:
+        return None
+    try:
+        dims = tuple(
+            int(d) for d in raw.replace("x", ",").split(",") if d.strip()
+        )
+    except ValueError:
+        return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    n = 1
+    for d in dims:
+        n *= d
+    return dims if n == size else None
+
+
+def serpentine_positions(dims: Sequence[int]) -> List[Tuple[int, ...]]:
+    """``position -> coordinate`` for a full grid walked in the same
+    boustrophedon order :func:`serpentine_device_order` uses, so virtual
+    rank ``p`` (mesh position ``p``) sits at physical coordinate
+    ``serpentine_positions(dims)[p]``."""
+
+    class _D:
+        def __init__(self, c):
+            self.coords = c
+
+    grid = np.indices(tuple(dims)).reshape(len(dims), -1).T
+    devs = [_D(tuple(int(v) for v in c)) for c in grid]
+    return [d.coords for d in serpentine_device_order(devs)]
+
+
+_ROUTE_CACHE: Dict[Tuple, Tuple[Tuple[int, ...], ...]] = {}
+
+
+def _pos_tables(dims: Tuple[int, ...]):
+    key = ("tables", dims)
+    hit = _ROUTE_CACHE.get(key)
+    if hit is None:
+        pos2coord = serpentine_positions(dims)
+        coord2pos = {c: p for p, c in enumerate(pos2coord)}
+        hit = (pos2coord, coord2pos)
+        _ROUTE_CACHE[key] = hit
+    return hit
+
+
+def route_ranks(
+    i: int, j: int, size: int, dims: Optional[Sequence[int]] = None
+) -> Tuple[int, ...]:
+    """Unit-hop relay chain ``(i, m1, ..., j)`` between virtual ranks.
+
+    Every consecutive pair in the chain is physically adjacent: on the
+    default virtual ring, hops are ±1 in serpentine order (single ICI
+    hops by construction of the placement); on a declared torus, hops
+    are dimension-ordered unit coordinate moves taking the shortest wrap
+    direction per axis. Deterministic, memoized per (i, j, size, dims).
+    """
+    assert 0 <= i < size and 0 <= j < size and i != j
+    dims_t = tuple(dims) if dims else None
+    key = (i, j, size, dims_t)
+    hit = _ROUTE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if dims_t is None or len(dims_t) == 1:
+        fwd = (j - i) % size
+        step = 1 if fwd <= size - fwd else -1
+        chain = [i]
+        cur = i
+        while cur != j:
+            cur = (cur + step) % size
+            chain.append(cur)
+        route = tuple(chain)
+    else:
+        pos2coord, coord2pos = _pos_tables(dims_t)
+        cur = list(pos2coord[i])
+        dst = pos2coord[j]
+        chain = [i]
+        for ax, d in enumerate(dims_t):
+            delta = (dst[ax] - cur[ax]) % d
+            step = 1 if delta <= d - delta else -1
+            while cur[ax] != dst[ax]:
+                cur[ax] = (cur[ax] + step) % d
+                chain.append(coord2pos[tuple(cur)])
+        route = tuple(chain)
+    _ROUTE_CACHE[key] = route
+    return route
+
+
+def hop_distance(
+    i: int, j: int, size: int, dims: Optional[Sequence[int]] = None
+) -> int:
+    """Physical hop count of the modeled route between virtual ranks."""
+    if i == j:
+        return 0
+    return len(route_ranks(i, j, size, dims)) - 1
+
+
+def perm_congestion(
+    perm: Sequence[Tuple[int, int]],
+    size: int,
+    dims: Optional[Sequence[int]] = None,
+) -> int:
+    """Max directed-link load of one ppermute round under the route model.
+
+    Each pair routes over its unit-hop chain; a directed physical link
+    shared by L routes serializes them, so the round's effective wire
+    time is L x the single-transfer time — the congestion factor the
+    compiler's alpha-beta model prices. Single-hop rounds (circulant ±1
+    offsets under serpentine placement) are 1 by construction.
+    """
+    load: Dict[Tuple[int, int], int] = {}
+    top = 1
+    for s, d in perm:
+        chain = route_ranks(s, d, size, dims)
+        for a, b in zip(chain[:-1], chain[1:]):
+            load[(a, b)] = load.get((a, b), 0) + 1
+            top = max(top, load[(a, b)])
+    return top

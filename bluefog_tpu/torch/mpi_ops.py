@@ -147,12 +147,16 @@ def _combine_with_plan(np_arr: np.ndarray, plan, compression=None):
     (one plan resolution; forward and backward share this path)."""
     rt_ctx = ctx_mod.get_context()
     arr = col_ops._check_worker_array(rt_ctx, np_arr)
-    body = col_ops._combine_for(compression)  # validates up front too
+    chunks = col_ops._plan_chunks(plan, arr)
+    route = (
+        plan.compile_info.route if plan.compile_info is not None else "direct"
+    )
+    body = col_ops._combine_for(compression, chunks)  # validates up front too
     combine = lambda xb: body(xb, plan, ctx_mod.WORKER_AXIS)
     fn = col_ops._compiled(
         rt_ctx,
         "neighbor_allreduce",
-        (plan, compression) + col_ops._aval_key(arr),
+        (plan, compression, chunks, route) + col_ops._aval_key(arr),
         combine,
         in_specs=col_ops.P(ctx_mod.WORKER_AXIS),
         out_specs=col_ops.P(ctx_mod.WORKER_AXIS),

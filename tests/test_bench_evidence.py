@@ -236,6 +236,127 @@ def test_elastic_evidence_file_committed():
     assert cache[0]["entries_with_live_token"] >= 1
 
 
+SWEEP_REQUIRED_KEYS = {
+    "payload_bytes", "cells_ms_per_step", "aa_baseline_ms",
+    "aa_noise_pct", "auto_choice", "auto_chunks", "measured_best",
+    "auto_tracks_best_within_noise", "rounds", "shortcut_rounds",
+}
+
+
+def _validate_sweep_lines(lines):
+    """Schema of the plan-sweep evidence family: calibration line with
+    measured constants, one sweep line per payload with every cell a
+    positive measured time (degenerate cells must be FLAGGED and
+    excluded from the winner comparison, never silently published)."""
+    cal = [l for l in lines if l.get("metric") == "plan_calibration"]
+    assert cal, "no plan_calibration line"
+    assert cal[0]["alpha_us"] > 0 and cal[0]["beta_gbytes_per_s"] > 0
+    assert 0.0 <= cal[0]["pipeline_eff"] <= 1.0
+    assert cal[0]["source"] in ("measured-probe", "class-constants")
+    sweep = [l for l in lines if l.get("metric") == "plan_sweep"]
+    assert sweep, "no plan_sweep lines"
+    for l in sweep:
+        missing = SWEEP_REQUIRED_KEYS - set(l)
+        assert not missing, (missing, l)
+        degenerate = set(l.get("degenerate_cells", ()))
+        for fam, ms in l["cells_ms_per_step"].items():
+            assert ms > 0 or fam in degenerate, l
+        if l["measured_best"] is not None:
+            assert l["measured_best"] not in degenerate, l
+        assert l["auto_chunks"] >= 1
+    return cal[0], sweep
+
+
+def test_plan_sweep_smoke_schema_and_bench_diff_check(tmp_path):
+    """BENCH_MODE=plan sweep smoke: provenance line asserted, sweep
+    schema validated, degenerate cells rejected from the winner pick —
+    and the artifact round-trips through tools/bench_diff.py --check
+    (self-diff), so future sweep artifact pairs stay machine-comparable
+    by default."""
+    out, lines = _run_mode(
+        "plan",
+        {
+            "BENCH_STEPS": "2", "BENCH_WINDOWS": "1",
+            "BENCH_PLAN_PAYLOAD_ELEMS": "1024",
+            "BENCH_PLAN_SWEEP_BYTES": "65536,262144",
+            "BENCH_PLAN_SWEEP_STEPS": "2",
+            "BENCH_PLAN_SWEEP_WINDOWS": "1",
+        },
+        timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    _assert_provenance(lines)
+    _validate_sweep_lines(lines)
+
+    artifact = tmp_path / "sweep.json"
+    artifact.write_text(
+        "\n".join(json.dumps(l) for l in lines) + "\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    diff = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
+         str(artifact), str(artifact), "--check", "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert diff.returncode == 0, diff.stderr
+    report = json.loads(diff.stdout)
+    assert not report["comparability_problems"], report
+    paired = [c for c in report["cells"] if c["status"] == "paired"]
+    assert paired, report
+    # a self-diff must show zero delta everywhere
+    for cell in paired:
+        for d in cell["deltas"].values():
+            assert d["delta_pct"] in (0.0, None), cell
+
+
+def test_plan_sweep_evidence_file_committed():
+    """PLAN_SWEEP_EVIDENCE.json (the committed BENCH_MODE=plan payload
+    sweep) carries the acceptance facts: measured calibration, the
+    64 KiB -> 100 MiB sweep, and the auto chooser tracking the measured
+    winner (within the disclosed A/A floor) at both sweep extremes —
+    small payload on the min-round plan, large payload chunked."""
+    path = os.path.join(REPO, "PLAN_SWEEP_EVIDENCE.json")
+    assert os.path.exists(path), "PLAN_SWEEP_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    cal, sweep = _validate_sweep_lines(lines)
+    assert cal["source"] == "measured-probe"
+    sweep.sort(key=lambda l: l["payload_bytes"])
+    assert sweep[0]["payload_bytes"] <= 64 * 1024
+    assert sweep[-1]["payload_bytes"] >= 100 * 1024 * 1024
+    for end in (sweep[0], sweep[-1]):
+        assert end["auto_tracks_best_within_noise"] is True, end
+    # the latency end stays on the min-round plan
+    assert sweep[0]["auto_choice"] == "coloring_k1", sweep[0]
+
+
+def test_bench_diff_flags_non_comparable_rounds():
+    """The committed r04-vs-r05 verdict artifact: the -10.3% headline
+    drop is recorded as NON-comparable (missing provenance + timing-
+    harness change), mechanizing the VERDICT.md 'Weak #1' judgment."""
+    path = os.path.join(REPO, "BENCH_DIFF_r04_r05.json")
+    assert os.path.exists(path), "BENCH_DIFF_r04_r05.json missing"
+    report = json.load(open(path))
+    assert report["comparability_problems"], report
+    headline = [
+        c for c in report["cells"]
+        if c["metric"] == "resnet50_bs64_imgs_per_sec_per_chip"
+        and c["status"] == "paired"
+    ]
+    assert headline, report["cells"]
+    cell = headline[0]
+    assert cell["verdict"] == "non-comparable"
+    assert cell.get("harness_change") is True
+    assert cell["deltas"]["value"]["delta_pct"] == pytest.approx(
+        -10.3, abs=0.1
+    )
+    assert report["notes"], "verdict annotation missing"
+
+
 def _on_tpu_host() -> bool:
     return os.environ.get("BLUEFOG_AMBIENT_PLATFORM", "") == "axon"
 

@@ -1,0 +1,164 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Doc-number drift guard: the throughput/MFU ranges README.md and
+docs/performance.md claim must contain the committed evidence artifacts.
+
+Mechanizes the ADVICE.md drift class ("~63k claimed vs 59.1k committed"):
+prose performance claims rot silently when a new bench round lands
+different numbers, so the claimed ranges are parsed OUT of the docs and
+the committed ``BENCH_r<latest>``/``EVIDENCE_r*`` values are asserted to
+fall inside them. Scope is the latest round's artifacts — earlier rounds
+(r02/r03) predate the round-4 readback-latency timing fix and are
+documented history, not current claims.
+
+The parsing is deliberately strict: if a claim pattern stops matching
+(rewording that drops the range), the guard FAILS rather than silently
+guarding nothing — update the regexes with the prose.
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    with open(os.path.join(REPO, path)) as f:
+        return f.read()
+
+
+def _artifact_lines(path):
+    text = _read(path)
+    try:
+        wrapper = json.loads(text)
+        raw = wrapper.get("tail", "").splitlines() if isinstance(
+            wrapper, dict
+        ) else []
+    except ValueError:
+        raw = text.splitlines()
+    out = []
+    for line in raw:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def _latest_round_artifacts():
+    """JSON metric lines of the newest BENCH_rN plus every committed
+    EVIDENCE file (the artifacts the docs cite as current)."""
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert rounds, "no committed BENCH_r*.json artifacts"
+    lines = _artifact_lines(os.path.basename(rounds[-1]))
+    for ev in sorted(glob.glob(os.path.join(REPO, "EVIDENCE_r*.json"))):
+        lines += _artifact_lines(os.path.basename(ev))
+    return lines
+
+
+def _committed(metric):
+    vals = [
+        (l.get("value"), l.get("mfu"))
+        for l in _latest_round_artifacts()
+        if l.get("metric") == metric and isinstance(
+            l.get("value"), (int, float)
+        )
+    ]
+    assert vals, f"no committed artifact line for {metric}"
+    return vals
+
+
+# -- claim parsers -----------------------------------------------------------
+
+RESNET_RANGE = re.compile(
+    r"~?\s*(\d[\d\s,]*?)\s*-\s*(\d[\d\s,]*?)\s*imgs?/sec/chip"
+)
+TOKENS_RANGE = re.compile(
+    r"~?\s*(\d+)\s*-\s*(\d+)\s*(k|\s?000)\s*tokens/sec"
+)
+MFU_RANGE = re.compile(
+    r"(?:\(|mfu\s+)(0\.\d+)\s*-\s*(0\.\d+)(?:\s*MFU|\b)", re.IGNORECASE
+)
+
+
+def _num(s):
+    return float(s.replace(",", "").replace(" ", ""))
+
+
+def _claims(doc):
+    """(resnet_range, resnet_mfu, tokens_range, tokens_mfu) per doc —
+    ranges are (lo, hi) floats; MFU ranges are matched nearest AFTER
+    each throughput claim so the two families never cross-wire."""
+    text = _read(doc)
+    res = RESNET_RANGE.search(text)
+    tok = TOKENS_RANGE.search(text)
+    assert res, f"{doc}: ResNet imgs/sec/chip range claim not found"
+    assert tok, f"{doc}: tokens/sec range claim not found"
+    resnet = (_num(res.group(1)), _num(res.group(2)))
+    scale = 1000.0
+    tokens = (_num(tok.group(1)) * scale, _num(tok.group(2)) * scale)
+
+    def mfu_after(pos):
+        m = MFU_RANGE.search(text, pos)
+        assert m, f"{doc}: no MFU range after offset {pos}"
+        return float(m.group(1)), float(m.group(2))
+
+    return {
+        "resnet": resnet,
+        "resnet_mfu": mfu_after(res.end()),
+        "tokens": tokens,
+        "tokens_mfu": mfu_after(tok.end()),
+    }
+
+
+DOCS = ["README.md", "docs/performance.md"]
+
+
+@pytest.mark.parametrize("doc", DOCS)
+def test_resnet_headline_claims_contain_committed_artifacts(doc):
+    claims = _claims(doc)
+    lo, hi = claims["resnet"]
+    mlo, mhi = claims["resnet_mfu"]
+    assert lo < hi and mlo < mhi
+    for value, mfu in _committed("resnet50_bs64_imgs_per_sec_per_chip"):
+        assert lo <= value <= hi, (
+            f"{doc} claims {lo}-{hi} imgs/sec/chip but a committed "
+            f"artifact records {value} — update the doc range or the "
+            "artifact set"
+        )
+        if mfu is not None:
+            assert mlo <= mfu <= mhi, (
+                f"{doc} claims MFU {mlo}-{mhi} but a committed artifact "
+                f"records {mfu}"
+            )
+
+
+@pytest.mark.parametrize("doc", DOCS)
+def test_transformer_claims_contain_committed_artifacts(doc):
+    claims = _claims(doc)
+    lo, hi = claims["tokens"]
+    mlo, mhi = claims["tokens_mfu"]
+    assert lo < hi and mlo < mhi
+    for value, mfu in _committed("transformer_lm_tokens_per_sec"):
+        assert lo <= value <= hi, (
+            f"{doc} claims {lo}-{hi} tokens/sec but a committed artifact "
+            f"records {value} — update the doc range or the artifact set"
+        )
+        if mfu is not None:
+            assert mlo <= mfu <= mhi, (
+                f"{doc} claims MFU {mlo}-{mhi} but a committed artifact "
+                f"records {mfu}"
+            )
+
+
+def test_guard_scope_is_latest_round():
+    """The guard watches the newest BENCH round (plus EVIDENCE files);
+    earlier rounds predate the round-4 timing fix and are history, not
+    claims — this pin documents that scoping decision."""
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert os.path.basename(rounds[-1]) >= "BENCH_r05.json"

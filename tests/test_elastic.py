@@ -617,6 +617,58 @@ def test_chaos_kill_int8_converges_to_survivor_consensus(order):
 
 
 @pytest.mark.chaos
+@pytest.mark.parametrize("order", ["atc"])
+def test_chaos_kill_chunked_plan_repairs_zero_stale(order, monkeypatch):
+    """Elastic repair of a CHUNKED plan: with BLUEFOG_PLAN_CHUNKS set,
+    the kill -> detect -> repair path recompiles the chunked lowering
+    under the live-token cache key with zero stale dispatches, and the
+    whole trajectory (through the repair) is bitwise the unchunked
+    run's — chunking is a schedule change even across a membership
+    change."""
+    def run(chunks):
+        monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", str(chunks))
+        try:
+            _init()
+            bf.set_topology(bf.topology.ExponentialTwoGraph(SIZE))
+            session = bf.elastic.start(policy="average")
+            session.inject("kill", rank=3, step=4)
+            opt = bf.DistributedAdaptThenCombineOptimizer(
+                optax.sgd(0.05)
+            )
+            guard = bf.elastic.guard(opt)
+            rng = np.random.RandomState(7)
+            x0 = rng.randn(SIZE, 1536).astype(np.float32)
+            params = {"w": bf.worker_values(lambda r: x0[r])}
+            state = opt.init(params)
+            traj = []
+            for t in range(10):
+                g = rng.randn(SIZE, 1536).astype(np.float32) * 0.1
+                params, state = guard.step(
+                    params, state, {"w": bf.worker_values(lambda r: g[r])}
+                )
+                traj.append(np.asarray(params["w"]).copy())
+            assert session.stale_dispatches == 0
+            assert len(session.repairs) == 1
+            assert 3 not in session.membership.live_ranks()
+            # the repaired static plan sits under a live-token key
+            ctx = bf.context.get_context()
+            live_keyed = [
+                k for k in ctx.op_cache
+                if k and k[0] == "static_plan" and k[-1] is not None
+            ]
+            assert live_keyed, "repaired plan not keyed by live token"
+            return traj
+        finally:
+            bf.elastic.stop()
+            bf.shutdown()
+
+    t2 = run(2)
+    t1 = run(1)
+    for a, b in zip(t1, t2):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.chaos
 def test_chaos_pushsum_mass_corrected_consensus():
     """Push-sum family: kill a rank mid-run; the repaired split is
     mass-conserving over survivors, so x-lane and p-lane totals are

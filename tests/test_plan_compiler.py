@@ -328,6 +328,338 @@ def test_windows_on_irregular_topology_use_packed_rounds():
         bf.shutdown()
 
 
+# -- bandwidth family: chunked / short-cut / Pareto chooser ------------------
+
+
+@pytest.fixture
+def clean_cost_model():
+    """Calibration is process-global; tests that install one must not
+    leak it into the class-constant assertions elsewhere."""
+    compiler.clear_calibration()
+    yield
+    compiler.clear_calibration()
+
+
+def test_chunk_bounds_512_aligned_and_covering():
+    for n, k in ((4096, 4), (4097, 4), (513, 8), (1 << 20, 64), (511, 3)):
+        bounds = inner.chunk_bounds(n, k)
+        assert bounds[0][0] == 0 and bounds[-1][1] == n
+        for (a0, b0), (a1, b1) in zip(bounds, bounds[1:]):
+            assert b0 == a1
+        for a, b in bounds[:-1]:
+            assert (b - a) % 512 == 0, (n, k, bounds)
+        assert len(bounds) <= max(1, k) + 1
+    assert inner.chunk_bounds(256, 8) == [(0, 256)]  # sub-grid payload
+    assert inner.chunk_bounds(4096, 1) == [(0, 4096)]
+
+
+def test_shortcut_perms_structure_fuzzed():
+    """Relay schedules on fuzzed digraphs: every round is a partial
+    permutation of UNIT hops (ring-adjacent under the default fabric),
+    chains occupy consecutive rounds, and the compiler's built-in relay
+    simulation (delivery correctness) passes — shortcut_perms raises
+    otherwise."""
+    rng = np.random.RandomState(5)
+    for _ in range(25):
+        size = rng.randint(3, 12)
+        edges = random_edges(rng, size)
+        if not edges:
+            continue
+        perms, inject, delivery = compiler.shortcut_perms(edges, size)
+        assert sorted(e for e, _ in delivery) == sorted(set(edges))
+        for perm in perms:
+            for s, d in perm:
+                assert (d - s) % size in (1, size - 1), (s, d, size)
+        for r, inj in enumerate(inject):
+            assert set(inj) <= {s for s, _ in perms[r]}
+
+
+def test_shortcut_combine_bitwise_dyadic():
+    """Short-cut relay lowering == offset lowering to the bit under
+    dyadic weights / integer inputs (the repo's exactness scheme for
+    cross-decomposition equivalence)."""
+    rng = np.random.RandomState(23)
+    edges = [(i, j) for i in range(SIZE) for j in range(SIZE) if i != j]
+    for _ in range(5):
+        w = dyadic_matrix(rng, SIZE)
+        naive = planlib.plan_from_matrix(w, edges=edges, method="offset")
+        sc = planlib.plan_from_matrix(w, edges=edges, method="shortcut")
+        assert sc.compile_info.route == "shortcut"
+        assert sc.compile_info.inject is not None
+        np.testing.assert_array_equal(
+            naive.weight_matrix(), sc.weight_matrix()
+        )
+        x = rng.randint(-8, 9, size=(SIZE, 16)).astype(np.float32)
+        np.testing.assert_array_equal(combine(naive, x), combine(sc, x))
+
+
+def test_shortcut_neighbor_relations_and_allgather():
+    """in/out-neighbors and gather slots of a short-cut plan come from
+    the DELIVERY table (relay pairs are transport, not neighbors), so
+    neighbor_allgather returns exactly the direct plan's output."""
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    direct = planlib.plan_from_topology(g, weighted=True)
+    sc = planlib.plan_from_topology(g, weighted=True, method="shortcut")
+    assert sc.in_neighbors == direct.in_neighbors
+    assert sc.out_neighbors == direct.out_neighbors
+    x = np.random.RandomState(3).randn(SIZE, 64).astype(np.float32)
+    ga = run_spmd(
+        lambda t: inner.neighbor_allgather(t, sc, AXIS), x,
+        out_specs=(P(AXIS), P(AXIS)),
+    )
+    gb = run_spmd(
+        lambda t: inner.neighbor_allgather(t, direct, AXIS), x,
+        out_specs=(P(AXIS), P(AXIS)),
+    )
+    np.testing.assert_array_equal(np.asarray(ga[0]), np.asarray(gb[0]))
+    np.testing.assert_array_equal(np.asarray(ga[1]), np.asarray(gb[1]))
+
+
+@pytest.mark.parametrize("elems", [4096, 8192 + 1536])
+def test_chunked_combine_bitwise_all_wires(elems):
+    """Chunked == monolithic to the BIT for arbitrary float inputs, for
+    the exact combine and both memoryless quantized wires (chunk bounds
+    snap to the 512-element scale grid; the exact path concatenates
+    received chunks back to full width before the accumulate so the
+    arithmetic graph is shape-identical)."""
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    plan = planlib.plan_from_topology(g, weighted=True)
+    x = np.random.RandomState(11).randn(SIZE, elems).astype(np.float32)
+
+    base = combine(plan, x)
+    for k in (2, 4, 8):
+        got = np.asarray(run_spmd(
+            functools.partial(
+                inner.weighted_combine, plan=plan, axis_name=AXIS, chunks=k
+            ), x,
+        ))
+        np.testing.assert_array_equal(base, got), k
+    for wire in ("int8", "bf16"):
+        qbase = np.asarray(run_spmd(
+            functools.partial(
+                inner.weighted_combine_quantized, plan=plan,
+                axis_name=AXIS, wire=wire,
+            ), x,
+        ))
+        for k in (2, 4):
+            got = np.asarray(run_spmd(
+                functools.partial(
+                    inner.weighted_combine_quantized, plan=plan,
+                    axis_name=AXIS, wire=wire, chunks=k,
+                ), x,
+            ))
+            np.testing.assert_array_equal(qbase, got), (wire, k)
+
+
+def test_chunked_ef_bitwise_output_and_state():
+    """int8_ef chunked == monolithic for output AND both CHOCO copies:
+    the state is positional over the flat payload and slices with it."""
+    import jax.numpy as jnp
+
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    plan = planlib.plan_from_topology(g, weighted=True)
+    perms = plan.perms
+    _sw, recv_w = plan.weight_operands()
+    elems = 4096
+    x = np.random.RandomState(13).randn(SIZE, elems).astype(np.float32)
+    e_self = np.random.RandomState(14).randn(SIZE, elems).astype(
+        np.float32
+    ) * 0.01
+    e_recv = np.zeros((SIZE, len(perms), elems), np.float32)
+
+    def run(chunks):
+        def body(t, es, er):
+            y, (es2, er2) = inner.weighted_combine_quantized_ef_operands(
+                t, (es[0], er[0]), perms, jnp.asarray(recv_w), AXIS,
+                chunks=chunks,
+            )
+            return y, jnp.expand_dims(es2, 0), jnp.expand_dims(er2, 0)
+        out = run_spmd(
+            body, x, e_self, e_recv, out_specs=(P(AXIS), P(AXIS), P(AXIS))
+        )
+        return [np.asarray(o) for o in out]
+
+    y1, s1, r1 = run(1)
+    for k in (2, 4):
+        yk, sk, rk = run(k)
+        np.testing.assert_array_equal(y1, yk)
+        np.testing.assert_array_equal(s1, sk)
+        np.testing.assert_array_equal(r1, rk)
+
+
+def test_choose_chunks_env_override_and_forced_methods(
+    clean_cost_model, monkeypatch
+):
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    compiled = planlib.plan_from_topology(g, weighted=True).compile_info
+    big = 100 * 1024 * 1024
+    monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", "4")
+    assert compiler.choose_chunks(compiled, big, n_elems=big // 4) == 4
+    # the override is capped so every chunk keeps a 512-elem scale group
+    assert compiler.choose_chunks(compiled, 4096, n_elems=1024) == 2
+    monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", "zero")
+    with pytest.raises(ValueError):
+        compiler.choose_chunks(compiled, big)
+    monkeypatch.delenv("BLUEFOG_PLAN_CHUNKS")
+    # forced structure methods pin k=1 (A/B isolation)
+    for m in ("offset", "coloring", "shortcut"):
+        assert compiler.choose_chunks(
+            compiled, big, n_elems=big // 4, method=m
+        ) == 1
+
+
+def test_choose_chunks_pareto_crossover(clean_cost_model):
+    """Under the (class-constant) cost model: small payloads stay at the
+    latency-optimal k=1, large payloads pipeline, and a calibration
+    that measured NO pipelining (pipeline_eff=0) never chunks — the
+    chooser can only pick what the fabric delivered."""
+    g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+    compiled = planlib.plan_from_topology(g, weighted=True).compile_info
+    assert compiled.rounds >= 2
+    small, big = 64 * 1024, 100 * 1024 * 1024
+    assert compiler.choose_chunks(compiled, small, n_elems=small // 4) == 1
+    k_big = compiler.choose_chunks(compiled, big, n_elems=big // 4)
+    assert k_big > 1
+    # chunked cost at the chosen k beats the serial plan cost
+    cong = compiled.congestion
+    assert compiler.pipelined_cost_s(big, k_big, cong) < (
+        compiler.pipelined_cost_s(big, 1, cong)
+    )
+    compiler.set_calibration(
+        1e-3, 1e9, pipeline_eff=0.0, source="test"
+    )
+    assert compiler.choose_chunks(compiled, big, n_elems=big // 4) == 1
+
+
+def test_calibration_roundtrip(clean_cost_model):
+    base = compiler.round_cost_s(1024)
+    compiler.set_calibration(0.5, 1024.0, pipeline_eff=0.5, source="test")
+    cal = compiler.calibration()
+    assert cal["source"] == "test" and cal["alpha_s"] == 0.5
+    assert compiler.round_cost_s(1024) == pytest.approx(0.5 + 1.0)
+    compiler.clear_calibration()
+    assert compiler.calibration()["source"] == "class-constants"
+    assert compiler.round_cost_s(1024) == pytest.approx(base)
+
+
+def test_compile_cache_distinguishes_method_and_fabric(monkeypatch):
+    edges = [(0, 3), (3, 6), (6, 1), (1, 0)]
+    a = compiler.compile_edges(edges, SIZE, method="coloring")
+    b = compiler.compile_edges(edges, SIZE, method="shortcut")
+    assert a is not b and b.route == "shortcut"
+    monkeypatch.setenv("BLUEFOG_TORUS_DIMS", "2,4")
+    c = compiler.compile_edges(edges, SIZE, method="shortcut")
+    assert c is not b  # declared fabric joins the compile-cache key
+    monkeypatch.delenv("BLUEFOG_TORUS_DIMS")
+
+
+def test_torus_routes_and_congestion():
+    from bluefog_tpu.topology import placement
+
+    # declared 4x4 torus: serpentine neighbors are unit hops; a pair far
+    # apart in ring order can be few torus hops
+    dims = (4, 4)
+    for i in range(15):
+        assert placement.hop_distance(i, i + 1, 16, dims) == 1
+    assert placement.hop_distance(0, 15, 16, dims) <= 2
+    route = placement.route_ranks(0, 15, 16, dims)
+    assert route[0] == 0 and route[-1] == 15
+    # ring model: an offset-2 full permutation loads every link twice
+    perm = tuple((i, (i + 2) % SIZE) for i in range(SIZE))
+    assert placement.perm_congestion(perm, SIZE) == 2
+    assert placement.perm_congestion(
+        tuple((i, (i + 1) % SIZE) for i in range(SIZE)), SIZE
+    ) == 1
+    # BLUEFOG_TORUS_DIMS validation: wrong product is ignored
+    assert placement.declared_torus_dims(16) is None
+
+
+def test_eager_cache_keys_unique_per_chunk_and_route(monkeypatch):
+    """ops-level: a chunk-count or route change dispatches its own
+    compiled program (cache-key uniqueness), with identical results."""
+    import bluefog_tpu as bf
+    from bluefog_tpu import context as ctx_mod
+
+    bf.init(devices=jax.devices("cpu")[:SIZE])
+    try:
+        g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
+        bf.set_topology(g)
+        x = bf.worker_values(
+            lambda r: np.random.RandomState(r).randn(2048).astype(
+                np.float32
+            )
+        )
+        ctx = ctx_mod.get_context()
+
+        def na_keys():
+            return {
+                k for k in ctx.op_cache if k[0] == "neighbor_allreduce"
+            }
+
+        monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", "1")
+        a = np.asarray(bf.neighbor_allreduce(x))
+        monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", "2")
+        b = np.asarray(bf.neighbor_allreduce(x))
+        assert len(na_keys()) == 2, na_keys()
+        np.testing.assert_array_equal(a, b)
+        monkeypatch.delenv("BLUEFOG_PLAN_CHUNKS")
+        monkeypatch.setenv("BLUEFOG_PLAN_METHOD", "shortcut")
+        c = np.asarray(bf.neighbor_allreduce(x))
+        assert len(na_keys()) == 3, na_keys()
+        np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-5)
+    finally:
+        bf.shutdown()
+
+
+@pytest.mark.parametrize("order", ["atc", "cta"])
+@pytest.mark.parametrize("wire", [None, "int8", "int8_ef"])
+def test_optimizer_chunked_trajectory_bitwise(order, wire, monkeypatch):
+    """The acceptance pin: BLUEFOG_PLAN_CHUNKS=4 vs =1 optimizer
+    trajectories are bitwise-identical for ATC/CTA x fp32/int8/int8_ef
+    (PR-2 buckets are the chunking grain; chunking is a schedule
+    change, never a numerics change)."""
+    import bluefog_tpu as bf
+    import optax
+
+    def run(chunks):
+        monkeypatch.setenv("BLUEFOG_PLAN_CHUNKS", str(chunks))
+        bf.init(devices=jax.devices("cpu")[:SIZE])
+        try:
+            bf.set_topology(topo.ExponentialTwoGraph(SIZE))
+            factory = (
+                bf.DistributedAdaptThenCombineOptimizer if order == "atc"
+                else bf.DistributedAdaptWithCombineOptimizer
+            )
+            opt = factory(
+                optax.sgd(0.1, momentum=0.9),
+                bf.CommunicationType.neighbor_allreduce,
+            )
+            if wire is not None:
+                opt.compression = wire
+            rng = np.random.RandomState(0)
+            params = {
+                "w": bf.worker_values(
+                    lambda r: rng.randn(2048).astype(np.float32)
+                    + np.float32(r)
+                )
+            }
+            state = opt.init(params)
+            traj = []
+            for step in range(3):
+                grads = {
+                    "w": params["w"] * np.float32(0.01 * (step + 1))
+                }
+                params, state = opt.step(params, state, grads)
+                traj.append(np.asarray(params["w"]).copy())
+            return traj
+        finally:
+            bf.shutdown()
+
+    t1, t4 = run(1), run(4)
+    for a, b in zip(t1, t4):
+        np.testing.assert_array_equal(a, b)
+
+
 # -- acceptance: 16-rank sparse digraph via BENCH_MODE=plan ------------------
 
 
@@ -344,6 +676,11 @@ def test_bench_plan_mode_16_rank_bound():
     env["BENCH_STEPS"] = "2"
     env["BENCH_WINDOWS"] = "1"
     env["BENCH_PLAN_PAYLOAD_ELEMS"] = "1024"
+    # keep the smoke fast: tiny payload sweep (the full 64KiB-100MiB
+    # sweep is the committed PLAN_SWEEP_EVIDENCE.json run)
+    env["BENCH_PLAN_SWEEP_BYTES"] = "65536,262144"
+    env["BENCH_PLAN_SWEEP_STEPS"] = "2"
+    env["BENCH_PLAN_SWEEP_WINDOWS"] = "1"
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO,

@@ -1,0 +1,307 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Pair two bench evidence artifacts and attribute their deltas.
+
+The perf-attribution harness of ROADMAP item 1: round-over-round bench
+movements (the r04 -> r05 ResNet50 headline drop, 2798.8 -> 2510.5
+img/s/chip) are only meaningful when the artifacts are *comparable* —
+same jax/jaxlib, same CPU, same timing method — and the delta clears the
+run's own disclosed noise floor. This tool mechanizes that judgment:
+
+- parses both artifacts (driver-wrapper ``{"tail": ...}`` JSON or raw
+  JSONL), builds one *cell* per (metric, identifying-config) pair;
+- pairs cells across the artifacts by metric + config, flags cells
+  present on one side only;
+- checks the PR-4 provenance line on both sides and flags
+  non-comparability: jax/jaxlib mismatch, CPU model mismatch,
+  timing-method mismatch, or a missing provenance block (artifacts
+  predating PR-4 — their deltas are attributed to "harness unknown",
+  never to the code);
+- computes per-cell deltas with a noise floor taken from the
+  measurements' own disclosed spread (best-of-N ``value``/``median``/
+  ``min`` windows, ``aa_noise_pct`` A/A lines) — a delta inside the
+  floor is reported as noise, not regression.
+
+``--check`` exits nonzero when either artifact is structurally unusable
+(no JSON lines, ambiguous duplicate cells), the mode CI wires in so
+future artifact pairs stay machine-comparable by default.
+
+Usage::
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json [--json]
+        [--check] [--note "..."] [--out report.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Identifying config keys: integers that select WHAT was measured (not
+# how fast it was). Everything string/bool-valued is identity by default.
+CONFIG_INT_KEYS = {
+    "n", "n_workers", "seq_len", "heads", "head_dim", "layers", "dim",
+    "batch", "payload_elems", "payload_bytes", "interval",
+    "workers_on_chip", "rounds", "shortcut_rounds", "naive_rounds",
+    "optimized_rounds", "lower_bound", "hlo_collective_permutes",
+    "params_m", "auto_chunks", "kill_step",
+}
+
+# Harness metadata: neither identity nor a measurement to diff.
+HARNESS_KEYS = {"windows", "degenerate", "degenerate_cells", "unit"}
+
+PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
+
+
+def parse_artifact(path: str) -> Tuple[List[dict], List[str]]:
+    """JSON lines of one artifact + structural problems found."""
+    problems: List[str] = []
+    with open(path) as f:
+        text = f.read()
+    lines: List[dict] = []
+    try:
+        wrapper = json.loads(text)
+        if isinstance(wrapper, dict) and "tail" in wrapper:
+            raw = wrapper["tail"].splitlines()
+            if isinstance(wrapper.get("parsed"), dict):
+                # the driver's parsed headline — covered by tail, but a
+                # truncated tail may hold ONLY the headline
+                raw.append(json.dumps(wrapper["parsed"]))
+        elif isinstance(wrapper, list):
+            raw = [json.dumps(o) for o in wrapper]
+        else:
+            raw = text.splitlines()
+    except ValueError:
+        raw = text.splitlines()
+    for line in raw:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            lines.append(obj)
+    if not lines:
+        problems.append(f"{path}: no metric JSON lines found")
+    return lines, problems
+
+
+def cell_identity(obj: dict) -> Tuple:
+    ident = []
+    for k in sorted(obj):
+        if k in ("metric",) or k in HARNESS_KEYS:
+            continue
+        v = obj[k]
+        if isinstance(v, str) or isinstance(v, bool) or k in CONFIG_INT_KEYS:
+            ident.append((k, v))
+    return (obj["metric"], tuple(ident))
+
+
+def cell_values(obj: dict) -> Dict[str, float]:
+    out = {}
+    for k, v in obj.items():
+        if k in ("metric",) or k in HARNESS_KEYS or k in CONFIG_INT_KEYS:
+            continue
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def noise_floor_pct(obj: dict) -> Optional[float]:
+    """The cell's own disclosed spread, as a percent of its headline
+    value: best-of-N windows publish value (best) + median + min, A/A
+    cells publish aa_noise_pct directly. None when nothing is
+    disclosed — the delta is then unattributable, not 'significant'."""
+    if "aa_noise_pct" in obj:
+        return float(obj["aa_noise_pct"])
+    v = obj.get("value")
+    lo = obj.get("min")
+    if isinstance(v, (int, float)) and isinstance(lo, (int, float)) and lo:
+        return abs(v - lo) / abs(lo) * 100.0
+    return None
+
+
+def build_cells(lines: List[dict], problems: List[str], path: str):
+    cells: Dict[Tuple, dict] = {}
+    provenance = None
+    for obj in lines:
+        if obj.get("metric") == "provenance":
+            provenance = obj
+            continue
+        key = cell_identity(obj)
+        if key in cells:
+            problems.append(
+                f"{path}: duplicate cell {key[0]} {dict(key[1])} — "
+                "ambiguous pairing"
+            )
+        cells[key] = obj
+    return cells, provenance
+
+
+def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
+    problems: List[str] = []
+    lines_a, pa = parse_artifact(path_a)
+    lines_b, pb = parse_artifact(path_b)
+    problems += pa + pb
+    cells_a, prov_a = build_cells(lines_a, problems, path_a)
+    cells_b, prov_b = build_cells(lines_b, problems, path_b)
+
+    incomparable: List[str] = []
+    if prov_a is None:
+        incomparable.append(
+            f"{os.path.basename(path_a)} has no provenance line (predates "
+            "the PR-4 provenance contract): platform/timing attribution "
+            "unknown"
+        )
+    if prov_b is None:
+        incomparable.append(
+            f"{os.path.basename(path_b)} has no provenance line (predates "
+            "the PR-4 provenance contract): platform/timing attribution "
+            "unknown"
+        )
+    if prov_a and prov_b:
+        for k in PROVENANCE_COMPARE:
+            va, vb = prov_a.get(k, ""), prov_b.get(k, "")
+            if va != vb:
+                incomparable.append(
+                    f"provenance mismatch on {k!r}: {va!r} vs {vb!r}"
+                )
+
+    report_cells = []
+    for key in sorted(set(cells_a) | set(cells_b), key=str):
+        metric, ident = key
+        a, b = cells_a.get(key), cells_b.get(key)
+        entry = {"metric": metric, "config": dict(ident)}
+        if a is None or b is None:
+            entry["status"] = "unpaired"
+            entry["present_in"] = (
+                os.path.basename(path_a) if b is None
+                else os.path.basename(path_b)
+            )
+            # a cell appearing/disappearing between rounds is itself a
+            # harness change worth flagging for headline metrics
+            report_cells.append(entry)
+            continue
+        va, vb = cell_values(a), cell_values(b)
+        shared = sorted(set(va) & set(vb))
+        only_a, only_b = sorted(set(va) - set(vb)), sorted(set(vb) - set(va))
+        floors = [
+            f for f in (noise_floor_pct(a), noise_floor_pct(b))
+            if f is not None
+        ]
+        floor = max(floors) if floors else None
+        deltas = {}
+        for k in shared:
+            if va[k] == 0:
+                deltas[k] = {"a": va[k], "b": vb[k], "delta_pct": None}
+                continue
+            pct = (vb[k] - va[k]) / abs(va[k]) * 100.0
+            deltas[k] = {
+                "a": va[k],
+                "b": vb[k],
+                "delta_pct": round(pct, 2),
+                "exceeds_noise_floor": (
+                    None if floor is None else bool(abs(pct) > floor)
+                ),
+            }
+        entry["status"] = "paired"
+        entry["noise_floor_pct"] = (
+            None if floor is None else round(floor, 2)
+        )
+        entry["deltas"] = deltas
+        if only_a or only_b:
+            entry["fields_only_in_one"] = {
+                "a": only_a, "b": only_b,
+            }
+            # a measurement field appearing/disappearing (e.g. the
+            # windows/median/min spread block) marks a timing-harness
+            # change — the delta cannot be pinned on the code
+            entry["harness_change"] = True
+        comparable = not incomparable and not (only_a or only_b)
+        if not comparable:
+            entry["verdict"] = "non-comparable"
+            entry["reasons"] = incomparable + (
+                ["measurement fields changed between rounds "
+                 "(timing-harness change)"] if (only_a or only_b) else []
+            )
+        elif floor is None:
+            entry["verdict"] = "comparable, no disclosed noise floor"
+        else:
+            sig = [
+                k for k, d in deltas.items()
+                if d.get("exceeds_noise_floor")
+            ]
+            entry["verdict"] = (
+                f"comparable; deltas beyond the {round(floor, 2)}% noise "
+                f"floor: {sig}" if sig
+                else f"comparable; all deltas within the "
+                     f"{round(floor, 2)}% noise floor"
+            )
+        report_cells.append(entry)
+
+    return {
+        "a": path_a,
+        "b": path_b,
+        "provenance_a": prov_a,
+        "provenance_b": prov_b,
+        "comparability_problems": incomparable,
+        "structural_problems": problems,
+        "cells": report_cells,
+        "notes": notes,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact_a")
+    ap.add_argument("artifact_b")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report to stdout")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero on structurally unusable artifacts")
+    ap.add_argument("--note", action="append", default=[],
+                    help="annotation(s) embedded in the report")
+    ap.add_argument("--out", help="also write the JSON report to this path")
+    args = ap.parse_args(argv)
+
+    report = compare(args.artifact_a, args.artifact_b, args.note)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        probs = report["comparability_problems"]
+        print(f"bench_diff: {args.artifact_a} vs {args.artifact_b}")
+        if probs:
+            print("NON-COMPARABLE:")
+            for p in probs:
+                print(f"  - {p}")
+        for cell in report["cells"]:
+            name = cell["metric"]
+            cfg = {k: v for k, v in cell["config"].items()
+                   if k not in ("unit",)}
+            if cell["status"] == "unpaired":
+                print(f"  {name} {cfg}: only in {cell['present_in']}")
+                continue
+            print(f"  {name} {cfg}: {cell['verdict']}")
+            for k, d in cell.get("deltas", {}).items():
+                if d.get("delta_pct") is not None:
+                    print(
+                        f"    {k}: {d['a']} -> {d['b']} "
+                        f"({d['delta_pct']:+.2f}%)"
+                    )
+    if args.check and report["structural_problems"]:
+        for p in report["structural_problems"]:
+            print(f"CHECK FAILED: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
