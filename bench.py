@@ -46,6 +46,22 @@ Individual families via ``BENCH_MODE``:
   dumps are fused by ``tools/trace_merge.py`` (merged-trace round count
   vs the compiled CommPlan, hang postmortem naming the killed rank and
   the stalled edges/rounds). See ``docs/flight.md``.
+- ``attribution``: step-time attribution doctor evidence
+  (``bf.doctor``, docs/doctor.md) — measured overhead at the default
+  sampling interval (<=1 % bound, asserted, A/A control disclosed),
+  the structural pin that unsampled steps dispatch the doctor-off
+  program under the same cache key, the bitwise on/off trajectory pin,
+  a sample's compute/comm/host decomposition, and a fault-plan
+  degraded-link scenario where the emitted advisory must name the
+  injected edge. Committed as ATTRIBUTION_EVIDENCE.json.
+
+Every run additionally emits an **ambient-drift anchor** line
+(``{"metric": "ambient_anchor"}``: the fixed dense bf16 matmul TFLOP/s
+of ``tools/perf_probe.py``, 8192^3 on TPU) and the ResNet50/transformer
+headlines carry ``vs_anchor`` (throughput per ambient TFLOP/s), so a
+cross-round headline delta is classifiable as ambient host drift vs a
+real change — ``tools/bench_diff.py`` consumes the anchor to make that
+call mechanically.
 
 Timing windows that come out degenerate (a clamped ``diff <= 0`` in
 ``timed_differenced`` — an ambient stall ate the differenced half) are
@@ -145,6 +161,75 @@ def _peak_flops(device) -> float:
         if kind.startswith(key):
             return val
     return 0.0
+
+
+_ANCHOR_LINE = None
+
+
+def _ambient_anchor() -> dict:
+    """The ambient-drift anchor: a fixed dense bf16 matmul
+    (``tools/perf_probe.py`` roofline probe — 8192^3 on TPU, a small
+    CPU-sized square otherwise) timed in THIS process right where the
+    evidence was measured. Same code, same shape, every round: when the
+    anchor moves between rounds the host moved, and a headline delta of
+    the same magnitude is ambient, not a regression (VERDICT Weak #1's
+    unattributable 2798.8 -> 2510.5 drop is the wound this closes).
+    Memoized so the headline's ``vs_anchor`` and the emitted anchor
+    line are the same measurement."""
+    global _ANCHOR_LINE
+    if _ANCHOR_LINE is None:
+        import jax
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.perf_probe import matmul_tflops
+
+        on_tpu = jax.devices()[0].platform not in ("cpu",)
+        n = int(
+            os.environ.get("BENCH_ANCHOR_N", "8192" if on_tpu else "512")
+        )
+        _ANCHOR_LINE = {
+            "metric": "ambient_anchor",
+            "n": n,
+            "dtype": "bfloat16",
+            "tflops": round(
+                matmul_tflops(n, iters=10 if on_tpu else 3, warmup=2), 4
+            ),
+            "device": jax.devices()[0].device_kind,
+        }
+    return _ANCHOR_LINE
+
+
+def bench_row_problems(row: dict) -> list:
+    """Physical-plausibility validator for one bench row: a published
+    measurement must not claim a non-positive time, and a fwd+bwd cell
+    can never undercut its own fwd. Returns the violations (empty =
+    plausible). Rows already flagged ``degenerate`` are exempt — their
+    values are disclosed as artifacts, not measurements. Wired into
+    ``run_flash`` (reject + remeasure) and unit-tested so impossible
+    rows cannot ship again (the r05 artifact committed a
+    ``dense_fwdbwd_ms`` below ``dense_fwd_ms``)."""
+    if row.get("degenerate"):
+        return []
+    problems = []
+    times = {
+        k: v for k, v in row.items()
+        if k.endswith("_ms") and isinstance(v, (int, float))
+        and not isinstance(v, bool)
+    }
+    for k, v in sorted(times.items()):
+        if v <= 0:
+            problems.append(f"{k}={v} is not a positive time")
+    for k, v in sorted(times.items()):
+        if "fwdbwd" not in k:
+            continue
+        fwd_key = k.replace("fwdbwd", "fwd")
+        f = times.get(fwd_key)
+        if f is not None and v < f:
+            problems.append(
+                f"{k}={v} < {fwd_key}={f}: fwd+bwd cannot be faster "
+                "than its own forward"
+            )
+    return problems
 
 
 # Tunnel-safe sync point (a plain np.asarray readback would cache on the
@@ -281,11 +366,16 @@ def run_headline() -> int:
     )
     per_chip = batch / dts[0]
     baseline_per_accel = 4310.6 / 16.0  # docs/performance.rst:16-24
+    anchor = _ambient_anchor()
     result = {
         "metric": "resnet50_bs%d_imgs_per_sec_per_chip" % batch,
         "value": round(per_chip, 2),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / baseline_per_accel, 4),
+        # throughput per ambient TFLOP/s: stable vs_anchor + moving
+        # value across rounds = the host moved, not the code
+        "vs_anchor": round(per_chip / max(anchor["tflops"], 1e-9), 3),
+        "anchor_tflops": anchor["tflops"],
         # window spread: best-of-N filters shared-tunnel stalls; the
         # median and worst window are disclosed so the headline is not
         # mistaken for a guaranteed-reproducible number
@@ -393,6 +483,7 @@ def run_scaling() -> int:
         return fn, (x, w)
 
     ns_weak = [n for n in (1, 2, 4, 8) if n <= n_dev]
+    virtual = os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native"
     for row in scaling.weak_scaling_times(make_step, ns_weak):
         lines.append(
             {
@@ -400,6 +491,10 @@ def run_scaling() -> int:
                 "n_workers": row["n"],
                 "ms_per_step": round(row["ms_per_step"], 3),
                 "efficiency": round(row["efficiency"], 4),
+                # virtual workers share one host's cores: these rows
+                # validate the HARNESS (the step runs, efficiency is
+                # computable), they are not a hardware scaling claim
+                "harness_validation": virtual,
             }
         )
 
@@ -1810,6 +1905,293 @@ def run_flight() -> int:
     return 0
 
 
+def run_attribution() -> int:
+    """Attribution-doctor evidence (``BENCH_MODE=attribution``,
+    committed as ATTRIBUTION_EVIDENCE.json). Four claims, measured the
+    way each is resolvable (the BENCH_MODE=metrics noise-floor lessons
+    apply unchanged):
+
+    1. **Structural pin**: the doctor never changes the training
+       program — enabling it adds no compiled-train-step cache entry
+       (its probe programs live under their own ``doctor_probe`` keys),
+       so every unsampled step dispatches the doctor-off program under
+       the doctor-off cache key by construction.
+    2. **Bitwise trajectory pin**: doctor on vs off, fresh state both
+       ways, identical training state to the bit.
+    3. **Overhead <= 1 % at the default interval**: the doctor's
+       per-sample cost (settle + per-round probes + anchor) is measured
+       directly by sampling EVERY step (interval 1) against a
+       doctor-off stepper in a step-level rotation (all orderings), and
+       amortized over the default interval; an off/off A/A control runs
+       the identical protocol as the disclosed noise floor.
+    4. **Degraded-link localization**: a fault-plan ``degrade`` on one
+       directed edge (the PR-4 chaos layer's deterministic wire
+       simulation); the doctor's per-round probes + per-edge drill-down
+       must emit a ``degraded_link`` advisory naming exactly the
+       injected edge — from timings alone.
+    """
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_ATTR_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import itertools
+    import time as time_mod
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import attribution
+    from bluefog_tpu.collective import compiler
+
+    devices = jax.devices()
+    n = min(len(devices), int(os.environ.get("BENCH_ATTR_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_ATTR_DIM", "256"))
+    layers = int(os.environ.get("BENCH_ATTR_LAYERS", "6"))
+    batch = int(os.environ.get("BENCH_ATTR_BATCH", "16"))
+    samples = max(18, int(os.environ.get("BENCH_ATTR_SAMPLES", "60")))
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_DOCTOR", "BLUEFOG_DOCTOR_INTERVAL",
+                  "BLUEFOG_DOCTOR_FILE", "BLUEFOG_METRICS")
+    }
+    os.environ.pop("BLUEFOG_DOCTOR", None)
+    # the evidence claims the DEFAULT interval: an ambient override
+    # would silently re-scope the committed overhead amortization
+    os.environ.pop("BLUEFOG_DOCTOR_INTERVAL", None)
+    os.environ.pop("BLUEFOG_DOCTOR_FILE", None)
+    os.environ.pop("BLUEFOG_METRICS", None)
+    default_interval = attribution.doctor_interval()
+
+    bf.init(devices=devices[:n])
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+    # calibrate ONCE up front: the doctor's lazy first-sample probe
+    # must not land inside a timed window
+    compiler.calibrate()
+
+    rng = np.random.RandomState(0)
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    xs = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+    ys = bf.worker_values(lambda r: rng.randn(batch, dim).astype(np.float32))
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def make_stepper():
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+        train_step = bf.make_train_step(opt, loss_fn)
+        params = {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+        carry = [(params, opt.init(params))]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs, ys)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, carry
+
+    try:
+        ctx = bf.get_context()
+
+        # -- claim 1: structural — no train-step cache entry changes ---------
+        attribution.stop()
+        stepper, _carry = make_stepper()
+        stepper()
+        stepper()
+        def train_keys():
+            return {
+                k for k in ctx.op_cache
+                if isinstance(k, tuple) and k
+                and k[0] in ("opt_step", "opt_fused_step")
+            }
+        keys_off = train_keys()
+        doc = attribution.start(interval=1)
+        stepper()
+        stepper()
+        keys_on = train_keys()
+        probe_keys = [
+            k for k in ctx.op_cache
+            if isinstance(k, tuple) and k and k[0] == "doctor_probe"
+        ]
+        unsampled_shared = keys_on == keys_off
+        attribution.stop()
+
+        # -- claim 2: bitwise trajectory pin ---------------------------------
+        state_bits = {}
+        for variant in ("off", "on"):
+            if variant == "on":
+                attribution.start(interval=3)
+            else:
+                attribution.stop()
+            _step, carry = make_stepper()
+            for _ in range(12):
+                _step()
+            state_bits[variant] = jax.tree_util.tree_leaves(carry[0])
+        attribution.stop()
+        bitwise = all(
+            bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            for a, b in zip(state_bits["off"], state_bits["on"])
+        )
+
+        # -- claim 3: overhead at the default interval -----------------------
+        steppers = {}
+        doc_on = attribution.StepDoctor(interval=1)
+        for variant in ("off", "on", "off2"):
+            attribution.activate(doc_on if variant == "on" else None)
+            steppers[variant], _ = make_stepper()
+            steppers[variant]()  # compile (+ probe compile for "on")
+            _settle(steppers[variant]())
+        orders = list(itertools.permutations(("off", "on", "off2")))
+        times = {v: [] for v in steppers}
+        for i in range(samples):
+            for variant in orders[i % len(orders)]:
+                attribution.activate(
+                    doc_on if variant == "on" else None
+                )
+                t0 = time_mod.perf_counter()
+                _settle(steppers[variant]())
+                times[variant].append(time_mod.perf_counter() - t0)
+        attribution.activate(None)
+
+        def median(v):
+            v = sorted(v)
+            return v[len(v) // 2] if v else 0.0
+
+        base_s = median(times["off"])
+        sample_extra_s = median(
+            [on - off for off, on in zip(times["off"], times["on"])]
+        )
+        control_extra_s = median(
+            [o2 - off for off, o2 in zip(times["off"], times["off2"])]
+        )
+        overhead_pct = (
+            100.0 * sample_extra_s / default_interval / base_s
+            if base_s > 0 else 0.0
+        )
+        control_pct = (
+            100.0 * control_extra_s / default_interval / base_s
+            if base_s > 0 else 0.0
+        )
+
+        # one representative decomposition sample from the on-doctor
+        decomp = {}
+        for s in reversed(doc_on.samples):
+            if "step_ms" in s and "comm_wire_ms" in s:
+                decomp = {
+                    "step_ms": s["step_ms"],
+                    "comm_wire_ms": s["comm_wire_ms"],
+                    "compute_ms": s.get("compute_ms"),
+                    "dispatch_ms": s.get("dispatch_ms"),
+                    "exposed_comm_frac": s.get("exposed_comm_frac"),
+                    "rounds": len(s.get("rounds", [])),
+                }
+                break
+
+        print(json.dumps({
+            "metric": "attribution_overhead",
+            "n_workers": n,
+            "payload_mb": round(layers * dim * dim * 4 / 1e6, 2),
+            "interval": default_interval,
+            "ms_per_step_off": round(base_s * 1e3, 3),
+            "ms_sampled_step_extra": round(sample_extra_s * 1e3, 3),
+            "overhead_pct": round(overhead_pct, 3),
+            "control_aa_pct": round(control_pct, 3),
+            "unsampled_program_shared": unsampled_shared,
+            "doctor_probe_programs": len(probe_keys),
+            "bitwise_identical": bitwise,
+            "samples": samples,
+        }))
+        print(json.dumps({
+            "metric": "attribution_sample", **decomp,
+        }))
+
+        # -- claim 4: degraded-link localization -----------------------------
+        bf.shutdown()
+        bf.init(devices=devices[:n])
+        bf.set_topology(topo.ExponentialTwoGraph(n))
+        compiler.calibrate()
+        # Exp2 edges are rank -> rank+2^k: degrade the single directed
+        # edge (kill_src, kill_dst) and make the doctor find it
+        kill_src = int(os.environ.get("BENCH_ATTR_DEGRADE_RANK", "2"))
+        kill_dst = (kill_src + 4) % n
+        session = bf.elastic.start(policy="average")
+        session.inject(
+            "degrade", rank=kill_src, step=0, factor=0.05, peer=kill_dst
+        )
+        doc = attribution.start(interval=2)
+        opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.05))
+        guard = bf.elastic.guard(opt)
+        params = {"w": bf.worker_values(
+            lambda r: rng.randn(4096).astype(np.float32)
+        )}
+        state = opt.init(params)
+        zeros = {"w": bf.worker_values(np.zeros(4096, np.float32))}
+        for _t in range(6):
+            params, state = guard.step(params, state, zeros)
+        linked = [
+            a.to_json() for a in doc.advisories
+            if a.kind == "degraded_link"
+        ]
+        named = sorted({tuple(a["edge"]) for a in linked})
+        named_correctly = (kill_src, kill_dst) in named
+        print(json.dumps({
+            "metric": "attribution_degraded_link",
+            "injected_edge": [kill_src, kill_dst],
+            "degrade_factor": 0.05,
+            "advisories": linked[:4],
+            "edges_named": [list(e) for e in named],
+            "named_correctly": named_correctly,
+        }))
+        attribution.stop()
+        bf.elastic.stop()
+    finally:
+        attribution.activate(None)
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        assert unsampled_shared, (
+            "enabling the doctor changed the compiled train-step "
+            "cache entries"
+        )
+        assert bitwise, (
+            "enabling the doctor changed the training state bitwise"
+        )
+        assert overhead_pct <= 1.0, (
+            f"doctor overhead {overhead_pct:.3f}% exceeds the 1% "
+            f"acceptance bound at interval {default_interval}"
+        )
+        assert named_correctly, (
+            f"degraded_link advisory failed to name the injected edge "
+            f"({kill_src}, {kill_dst}): named {named}"
+        )
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -1876,10 +2258,13 @@ def run_transformer() -> int:
     # fwd FLOPs/token = 2*P (params matmuls) + 2*T*dim*L (causal QK^T+PV
     # at average context T/2, both 2*MAC); fwd+bwd = 3x fwd
     flops_token = 3 * (2 * n_params + 2 * seq * dim * layers)
+    anchor = _ambient_anchor()
     result = {
         "metric": "transformer_lm_tokens_per_sec",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/sec/chip",
+        "vs_anchor": round(tok_per_sec / max(anchor["tflops"], 1e-9), 2),
+        "anchor_tflops": anchor["tflops"],
         "seq_len": seq,
         "params_m": round(n_params / 1e6, 1),
         "dim": dim, "heads": heads, "layers": layers, "batch": batch,
@@ -1951,34 +2336,55 @@ def run_flash() -> int:
                 # compute per window half (sub-second windows are pure
                 # tunnel-RTT noise)
                 flops = 2.0 * t * t * h * d * 1 * cost_mult  # causal ~half
-                est = flops / 2.0e13  # ~10% of peak as a sizing guess
-                steps = max(8, min(4096, int(1.0 / max(est, 1e-7))))
+                # floored: a sub-ms shape's per-call time is dominated
+                # by dispatch (~50 us), not FLOPs — an unfloored
+                # estimate requests absurd step counts and the window
+                # measures dispatch noise, the r05 impossible-row root
+                est = max(flops / 2.0e13, 5e-5)
+                steps = max(8, min(4096, int(1.0 / est)))
                 dts, degen = _timed_differenced(
                     lambda: fn(q, k, v), steps, windows,
                     with_degenerate=True,
                 )
                 return dts[0], degen
 
-            (tf, d1), (tr, d2) = measure(f_fwd, 1), measure(r_fwd, 2)
-            (tfb, d3), (trb, d4) = measure(f_bwd, 3), measure(r_bwd, 6)
-            degenerate = d1 or d2 or d3 or d4
+            def one_cell():
+                (tf, d1), (tr, d2) = measure(f_fwd, 1), measure(r_fwd, 2)
+                (tfb, d3), (trb, d4) = measure(f_bwd, 3), measure(r_bwd, 6)
+                degenerate = d1 or d2 or d3 or d4
+                cell = {
+                    "metric": "flash_attention_vs_dense",
+                    "seq_len": t, "heads": h, "head_dim": d,
+                    "causal": True,
+                    "flash_fwd_ms": round(tf * 1e3, 3),
+                    "dense_fwd_ms": round(tr * 1e3, 3),
+                    "fwd_speedup": round(tr / tf, 2),
+                    "flash_fwdbwd_ms": round(tfb * 1e3, 3),
+                    "dense_fwdbwd_ms": round(trb * 1e3, 3),
+                    "fwdbwd_speedup": round(trb / tfb, 2),
+                }
+                if degenerate:
+                    # every timing window stayed clamped even after
+                    # retries: disclose instead of publishing a fake
+                    # ~0 ms cell (and keep the cell out of the
+                    # regression assertion below)
+                    cell["degenerate"] = True
+                return cell, degenerate, (tr / tf, trb / tfb)
+
+            cell, degenerate, sp = one_cell()
+            problems = bench_row_problems(cell)
+            if problems:
+                # an impossible row never ships as a measurement: one
+                # full remeasure (transient stalls are the usual cause),
+                # then reject the cell with its violations disclosed
+                cell, degenerate, sp = one_cell()
+                problems = bench_row_problems(cell)
+                if problems:
+                    cell["degenerate"] = True
+                    cell["rejected"] = problems
+                    degenerate = True
             if not degenerate:
-                speedups[(h, d, t)] = (tr / tf, trb / tfb)
-            cell = {
-                "metric": "flash_attention_vs_dense",
-                "seq_len": t, "heads": h, "head_dim": d, "causal": True,
-                "flash_fwd_ms": round(tf * 1e3, 3),
-                "dense_fwd_ms": round(tr * 1e3, 3),
-                "fwd_speedup": round(tr / tf, 2),
-                "flash_fwdbwd_ms": round(tfb * 1e3, 3),
-                "dense_fwdbwd_ms": round(trb * 1e3, 3),
-                "fwdbwd_speedup": round(trb / tfb, 2),
-            }
-            if degenerate:
-                # every timing window stayed clamped even after retries:
-                # disclose instead of publishing a fake ~0 ms cell (and
-                # keep the cell out of the regression assertion below)
-                cell["degenerate"] = True
+                speedups[(h, d, t)] = sp
             print(json.dumps(cell))
     if on_tpu and os.environ.get("BENCH_ASSERT", "1") != "0":
         # stall-robust regression check: a single tunnel stall can distort
@@ -2004,7 +2410,8 @@ def run_all() -> int:
     import subprocess
 
     for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
-                 "flight", "gossip", "flash", "transformer"):
+                 "flight", "attribution", "gossip", "flash",
+                 "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -2038,27 +2445,30 @@ def run_all() -> int:
 def main() -> int:
     mode = os.environ.get("BENCH_MODE", "")
     print(json.dumps(_provenance()), flush=True)
-    if mode == "scaling":
-        return run_scaling()
-    if mode == "elastic":
-        return run_elastic()
-    if mode == "plan":
-        return run_plan()
-    if mode == "overlap":
-        return run_overlap()
-    if mode == "metrics":
-        return run_metrics()
-    if mode == "flight":
-        return run_flight()
-    if mode == "gossip":
-        return run_gossip_overhead()
-    if mode == "transformer":
-        return run_transformer()
-    if mode == "flash":
-        return run_flash()
-    if mode == "headline":
-        return run_headline()
-    return run_all()
+    runners = {
+        "scaling": run_scaling,
+        "elastic": run_elastic,
+        "plan": run_plan,
+        "overlap": run_overlap,
+        "metrics": run_metrics,
+        "flight": run_flight,
+        "attribution": run_attribution,
+        "gossip": run_gossip_overhead,
+        "transformer": run_transformer,
+        "flash": run_flash,
+        "headline": run_headline,
+    }
+    rc = runners.get(mode, run_all)()
+    # the ambient-drift anchor closes EVERY evidence artifact: measured
+    # after the mode ran (the mode owns backend/platform init), memoized
+    # so a headline's embedded vs_anchor is this same measurement
+    try:
+        print(json.dumps(_ambient_anchor()), flush=True)
+    except Exception as e:  # an anchor failure must not fail the bench
+        print(json.dumps({
+            "metric": "ambient_anchor", "error": str(e)[:200],
+        }), flush=True)
+    return rc
 
 
 if __name__ == "__main__":

@@ -88,6 +88,8 @@ from bluefog_tpu.timeline import (
 from bluefog_tpu.logging_util import logger, set_log_level
 from bluefog_tpu import flight
 from bluefog_tpu.flight import dump as flight_dump
+from bluefog_tpu import attribution
+from bluefog_tpu import attribution as doctor  # bf.doctor facade
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
     metrics_export,
@@ -333,6 +335,8 @@ __all__ = [
     "elastic",
     "flight",
     "flight_dump",
+    "attribution",
+    "doctor",
     "metrics",
     "metrics_snapshot",
     "metrics_export",

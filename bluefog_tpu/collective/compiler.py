@@ -86,6 +86,7 @@ __all__ = [
     "round_cost_s",
     "plan_cost_s",
     "pipelined_cost_s",
+    "predicted_round_costs_s",
     "choose_chunks",
     "chunk_option",
     "calibrate",
@@ -722,6 +723,27 @@ def compile_edges(
         _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
     _COMPILE_CACHE[key] = result
     return result
+
+
+def predicted_round_costs_s(
+    info, payload_bytes: float, n_rounds: Optional[int] = None,
+) -> Tuple[float, ...]:
+    """Per-round predicted cost at ``payload_bytes`` under the active
+    calibration: the model the attribution doctor compares measured
+    round times against (per-edge residuals localize degraded links —
+    see :mod:`bluefog_tpu.attribution`). ``info`` is a
+    :class:`CompiledEdges` (its per-round congestion prices each
+    round), or None with an explicit ``n_rounds`` for plans that carry
+    no compile record (explicit-weight / dynamic plans): every round is
+    then priced congestion-1."""
+    if info is not None and info.congestion:
+        return tuple(
+            round_cost_s(payload_bytes, c) for c in info.congestion
+        )
+    n = n_rounds if n_rounds is not None else (
+        info.rounds if info is not None else 0
+    )
+    return tuple(round_cost_s(payload_bytes) for _ in range(n))
 
 
 # -- the (rounds, chunks, route) Pareto chooser ------------------------------

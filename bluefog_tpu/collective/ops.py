@@ -66,10 +66,53 @@ __all__ = [
 _handle_map: Dict[int, Tuple] = {}
 _handle_counter = itertools.count()
 
+# Fire-and-forget reclamation bound: a caller that dispatches
+# nonblocking ops and never synchronizes them (diffusion-style
+# gossip-and-move-on loops) would otherwise grow _handle_map without
+# bound, pinning every superseded output buffer alive. Above this many
+# outstanding handles, each new dispatch reaps the OLDEST handles whose
+# results are already ready — by definition the ones a synchronize-never
+# caller abandoned. A caller holding more than this many genuinely
+# pending handles keeps them all (pending results are never reaped),
+# but a burst that dispatches MORE ready ops than the bound and only
+# then synchronizes will find its oldest results reclaimed — the bound
+# is sized well past any per-layer dispatch pattern, and
+# BLUEFOG_HANDLE_REAP_THRESHOLD overrides it (<= 0 disables
+# reclamation entirely, restoring unbounded growth).
+_HANDLE_REAP_THRESHOLD = int(
+    os.environ.get("BLUEFOG_HANDLE_REAP_THRESHOLD", "1024")
+)
+
+
+def _result_ready(result) -> bool:
+    leaves = jax.tree_util.tree_leaves(result)
+    return all(
+        leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready")
+    )
+
+
+def _reap_ready_handles() -> None:
+    if _HANDLE_REAP_THRESHOLD <= 0:
+        return
+    if len(_handle_map) <= _HANDLE_REAP_THRESHOLD:
+        return
+    excess = len(_handle_map) - _HANDLE_REAP_THRESHOLD
+    for handle in sorted(_handle_map)[: 4 * excess]:
+        if excess <= 0:
+            break
+        result, _post = _handle_map[handle]
+        if _result_ready(result):
+            del _handle_map[handle]
+            excess -= 1
+
 
 def _new_handle(result, post=None) -> int:
     """Register dispatched output; ``post`` (host-side) runs at synchronize
-    so nonblocking+synchronize returns exactly what the blocking op does."""
+    so nonblocking+synchronize returns exactly what the blocking op does.
+    Each dispatch also reaps abandoned (ready, never-synchronized)
+    handles past the fire-and-forget bound — see
+    :data:`_HANDLE_REAP_THRESHOLD`."""
+    _reap_ready_handles()
     handle = next(_handle_counter)
     _handle_map[handle] = (result, post)
     return handle
@@ -77,10 +120,13 @@ def _new_handle(result, post=None) -> int:
 
 def poll(handle: int) -> bool:
     """True when the op behind ``handle`` has finished executing
-    (reference ``mpi_ops.py:901-914``)."""
-    result, _ = _handle_map[handle]
-    leaves = jax.tree_util.tree_leaves(result)
-    return all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready"))
+    (reference ``mpi_ops.py:901-914``). A handle no longer in the map
+    (synchronized, or reclaimed as fire-and-forget — reclamation only
+    ever removes READY results) polls True."""
+    entry = _handle_map.get(handle)
+    if entry is None:
+        return True
+    return _result_ready(entry[0])
 
 
 def synchronize(handle: int):
@@ -89,7 +135,15 @@ def synchronize(handle: int):
     The wait is registered with the stall watchdog (the reference's 60-s
     coordinator stall scan, operations.cc:388-433, re-targeted at host
     blocking points)."""
-    result, post = _handle_map.pop(handle)
+    entry = _handle_map.pop(handle, None)
+    if entry is None:
+        raise ValueError(
+            f"unknown handle {handle}: already synchronized, or reclaimed "
+            "as fire-and-forget (a ready handle left unsynchronized past "
+            f"{_HANDLE_REAP_THRESHOLD} outstanding ops). Synchronize "
+            "handles promptly when you need their results."
+        )
+    result, post = entry
     # The host blocking point is where a hang becomes observable: the
     # flight ring gets the begin/ready pair so a postmortem can name
     # the last wait each rank completed and the one it died inside.
@@ -304,8 +358,10 @@ def _resolve_plan(
 
     dynamic = dst_weights is not None
     if not dynamic:
-        # src keys must be in-neighbors (reference mpi_ops.py:513-517).
-        in_sets = [set(lst) for lst in ctx.in_neighbor_ranks()]
+        # src keys must be in-neighbors (reference mpi_ops.py:513-517);
+        # the sets come from the topo_version-keyed context cache so the
+        # per-call validation is O(keys), not an O(N*E) graph walk
+        in_sets = ctx.in_neighbor_sets()
         per_rank = (
             [src_weights.get(r, {}) for r in range(ctx.size)]
             if isinstance(src_weights, dict)

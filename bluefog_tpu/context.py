@@ -191,6 +191,10 @@ class BluefogContext:
 
         self._topology: Optional[nx.DiGraph] = None
         self._topo_weighted: bool = False
+        # in-neighbor set cache, invalidated by topo_version: the eager
+        # explicit-weights hot path validates src keys against these on
+        # EVERY call, and rebuilding them is an O(N*E) networkx walk
+        self._neighbor_sets_cache: Optional[tuple] = None
         self._machine_topology: Optional[nx.DiGraph] = None
         self._machine_topo_weighted: bool = False
         # Monotonic versions for cache keys: id(graph) is unsafe (CPython
@@ -274,6 +278,27 @@ class BluefogContext:
 
     # -- neighbor queries (reference basics.py:203-265) ----------------------
 
+    def in_neighbor_sets(self):
+        """Per-rank frozen in-neighbor sets of the active topology,
+        cached on ``topo_version``: the warm path is one version compare
+        and a tuple return, so per-call weight validation
+        (:func:`bluefog_tpu.collective.ops._resolve_plan`) does O(1)
+        host work instead of an O(N*E) graph walk per eager dispatch
+        (pinned by tests/test_collective.py, mirroring the window
+        layer's host-cost pin)."""
+        cached = self._neighbor_sets_cache
+        if cached is not None and cached[0] == self.topo_version:
+            return cached[1]
+        assert self._topology is not None
+        sets = tuple(
+            frozenset(
+                r for r in self._topology.predecessors(rank) if r != rank
+            )
+            for rank in range(self.size)
+        )
+        self._neighbor_sets_cache = (self.topo_version, sets)
+        return sets
+
     def in_neighbor_ranks(self, rank: Optional[int] = None):
         assert self._topology is not None
         if rank is None:
@@ -344,6 +369,7 @@ def init(
         )
     # Reference behavior: BLUEFOG_TIMELINE=<prefix> activates tracing at
     # init (operations.cc:464-473).
+    from bluefog_tpu import attribution as _attribution
     from bluefog_tpu import flight as _flight
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
@@ -353,6 +379,9 @@ def init(
     # clock handshake can pair the timeline clock with wall/monotonic —
     # the anchor tools/trace_merge.py aligns ranks with.
     _flight.on_init(_context)
+    # Attribution doctor (BLUEFOG_DOCTOR=1): fresh session per mesh so
+    # stale baselines never advise a new topology.
+    _attribution.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -367,12 +396,14 @@ def shutdown() -> None:
     timeline the user opened with ``timeline_init`` stays open (it is
     theirs to close)."""
     global _context
+    from bluefog_tpu import attribution as _attribution
     from bluefog_tpu import elastic as _elastic
     from bluefog_tpu import flight as _flight
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
     _elastic.stop()
+    _attribution.on_shutdown()
     if _context is not None:
         # session_end lands in the ring (and the crash hooks detach)
         # while the timeline is still open for the clock pairing

@@ -23,7 +23,15 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
   repair.
 - ``degrade``  — from ``step`` on the rank's gossip edges are scaled by
   ``factor`` (and receiver weights renormalized) at the next repair:
-  the TopoOpt-style "co-optimize around a slow link" response.
+  the TopoOpt-style "co-optimize around a slow link" response. An
+  optional ``peer=P`` narrows the fault to the single directed edge
+  ``(rank, P)`` — a wire-level chaos primitive: repair re-weighting is
+  rank-granular and is deliberately NOT triggered by a narrowed fault
+  (it would down-weight the rank's healthy edges too). Active degrade
+  faults, narrowed or not, slow the attribution doctor's wire probes
+  deterministically (:meth:`~bluefog_tpu.elastic.recovery.
+  ElasticSession.simulated_wire_factors`) so degraded-link *detection*
+  is testable on a mesh with no physically slow link.
 
 Programmatic equivalent: :func:`bluefog_tpu.elastic.inject`.
 """
@@ -49,6 +57,11 @@ class Fault:
     step: int
     seconds: float = 0.0  # stall duration (simulated)
     factor: float = 1.0  # degrade link-quality scale
+    # degrade target: -1 degrades every edge of `rank`; a peer rank
+    # narrows it to the single directed edge (rank, peer) — the form
+    # the attribution doctor's degraded-link localization is tested
+    # against (a single slow link, not a slow host)
+    peer: int = -1
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -65,6 +78,11 @@ class Fault:
             raise ValueError(
                 f"degrade factor must be in (0, 1], got {self.factor}"
             )
+        if self.peer >= 0 and self.kind != "degrade":
+            raise ValueError(
+                f"peer= only applies to degrade faults, got kind "
+                f"{self.kind!r}"
+            )
 
 
 def _parse_clause(clause: str) -> Fault:
@@ -80,11 +98,11 @@ def _parse_clause(clause: str) -> Fault:
                 )
             k, v = pair.split("=", 1)
             fields[k.strip().lower()] = v.strip()
-    unknown = set(fields) - {"rank", "step", "seconds", "factor"}
+    unknown = set(fields) - {"rank", "step", "seconds", "factor", "peer"}
     if unknown:
         raise ValueError(
             f"unknown fault fields {sorted(unknown)} in {clause!r}; "
-            "accepted: rank, step, seconds, factor"
+            "accepted: rank, step, seconds, factor, peer"
         )
     for required in ("rank", "step"):
         if required not in fields:
@@ -97,6 +115,7 @@ def _parse_clause(clause: str) -> Fault:
         step=int(fields["step"]),
         seconds=float(fields.get("seconds", 0.0)),
         factor=float(fields.get("factor", 1.0)),
+        peer=int(fields.get("peer", -1)),
     )
 
 
@@ -147,5 +166,10 @@ class FaultPlan:
             if not 0 <= f.rank < world_size:
                 raise ValueError(
                     f"fault plan names rank {f.rank} but the mesh has "
+                    f"{world_size} workers"
+                )
+            if f.peer >= world_size:
+                raise ValueError(
+                    f"fault plan names peer {f.peer} but the mesh has "
                     f"{world_size} workers"
                 )

@@ -213,17 +213,39 @@ class ElasticSession:
     # -- chaos replay --------------------------------------------------------
 
     def inject(self, kind: str, rank: int, step: int, *, seconds: float = 0.0,
-               factor: float = 1.0) -> Fault:
+               factor: float = 1.0, peer: int = -1) -> Fault:
         """Programmatic fault injection (the ``BLUEFOG_FAULT_PLAN`` API
-        twin): schedule a fault on this session's own step clock."""
+        twin): schedule a fault on this session's own step clock.
+        ``peer`` narrows a degrade fault to the single directed edge
+        ``(rank, peer)``."""
         fault = Fault(kind=kind, rank=int(rank), step=int(step),
-                      seconds=float(seconds), factor=float(factor))
+                      seconds=float(seconds), factor=float(factor),
+                      peer=int(peer))
         if not 0 <= fault.rank < self.ctx.size:
             raise ValueError(
                 f"rank {fault.rank} out of range for {self.ctx.size} workers"
             )
+        if fault.peer >= self.ctx.size:
+            raise ValueError(
+                f"peer {fault.peer} out of range for {self.ctx.size} workers"
+            )
         self.plan.add(fault)
         return fault
+
+    def simulated_wire_factors(self) -> Dict:
+        """Degrade faults active at the current session step, as a
+        ``{(src, dst) | rank: factor}`` map — the deterministic wire
+        simulation the attribution doctor's probe dispatches consult
+        (:mod:`bluefog_tpu.attribution`). A tier-1 virtual mesh has no
+        physically slow link; this is the chaos layer's stand-in, so
+        degraded-link *localization from timings alone* is a
+        reproducible unit test."""
+        out: Dict = {}
+        for f in self.plan.faults:
+            if f.kind == "degrade" and f.step <= self.step:
+                key = (f.rank, f.peer) if f.peer >= 0 else f.rank
+                out[key] = min(out.get(key, 1.0), f.factor)
+        return out
 
     def _apply_fault(self, fault: Fault, step: int) -> None:
         metrics_mod.counter("bluefog.elastic.faults").inc()
@@ -234,7 +256,7 @@ class ElasticSession:
         flight.note_fault(
             fault_kind=fault.kind, rank=fault.rank, step=step,
             seconds=fault.seconds, factor=fault.factor,
-            topo_version=self.ctx.topo_version,
+            peer=fault.peer, topo_version=self.ctx.topo_version,
         )
         if fault.kind == "kill":
             if self.membership.mark_dead(fault.rank, "killed", step):
@@ -270,7 +292,22 @@ class ElasticSession:
                     f"{fault.seconds:g}s", "FAULT"
                 )
         elif fault.kind == "degrade":
-            if self.membership.mark_degraded(fault.rank, fault.factor, step):
+            if fault.peer >= 0:
+                # edge-narrowed degrade: a wire-level chaos primitive.
+                # Repair re-weighting is rank-granular (repair.py's
+                # degraded map keys ranks) — triggering it here would
+                # down-weight the rank's HEALTHY edges too, so the
+                # narrowed fault only feeds the deterministic wire
+                # simulation (simulated_wire_factors -> the attribution
+                # doctor's probes) and the record surfaces (note_fault
+                # above already carries peer=). Single-edge topology
+                # response is ROADMAP item 5's job.
+                tl.timeline_record_instant(
+                    f"elastic:degrade edge={fault.rank}->{fault.peer} "
+                    f"factor={fault.factor:g}", "FAULT"
+                )
+            elif self.membership.mark_degraded(fault.rank, fault.factor,
+                                               step):
                 self._degrade_dirty = True
                 tl.timeline_record_instant(
                     f"elastic:degrade rank={fault.rank} "

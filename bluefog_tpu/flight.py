@@ -49,6 +49,10 @@ What gets recorded (event ``kind`` -> payload):
 - ``membership`` / ``fault`` / ``repair`` — elastic verdicts with
   epoch, reason, and the topology version the verdict was filed under.
 - ``stall`` — watchdog deadline hits.
+- ``advisory`` — doctor diagnoses (:mod:`bluefog_tpu.attribution`):
+  degraded_link / straggler / recompile_storm / consensus_stall /
+  ambient_drift, with their evidence, kept eviction-proof in a side
+  table like faults.
 - ``crash`` / ``sigterm`` — the run's last words.
 
 Dump triggers: a watchdog stall, an elastic SUSPECT/DEAD verdict, an
@@ -79,6 +83,7 @@ __all__ = [
     "events",
     "note_plan",
     "note_fault",
+    "note_advisory",
     "dump",
     "maybe_dump",
     "dump_dir",
@@ -145,6 +150,10 @@ _plans: List[dict] = []  # bounded side table of compiled plan structures
 _faults: List[dict] = []  # bounded side table of fault verdicts: the
 # postmortem's fault -> plan linkage must survive ring eviction on long
 # runs, exactly like the plan structures themselves
+_advisories: List[dict] = []  # bounded side table of doctor advisories
+# (bluefog_tpu.attribution): a postmortem that cannot see "degraded_link
+# fired 40 minutes ago" mis-tells the story, so advisory history gets
+# the same eviction-proof treatment as faults
 _plans_lock = threading.Lock()
 _hooks_installed = False
 _prev_excepthook = None
@@ -196,6 +205,7 @@ def reconfigure() -> None:
     with _plans_lock:
         _plans.clear()
         _faults.clear()
+        _advisories.clear()
     del _dump_history[:]
 
 
@@ -263,6 +273,25 @@ def note_fault(**data) -> None:
         _faults.append(dict(data))
         del _faults[:-64]
     record("fault", **data)
+
+
+def note_advisory(**data) -> None:
+    """Record a doctor advisory (:mod:`bluefog_tpu.attribution`) in BOTH
+    the ring and a bounded side table, mirroring :func:`note_fault`: the
+    triage report (``tools/doctor.py``) joins advisories against dump
+    reasons and fault verdicts, and that history must survive ring
+    eviction."""
+    if not enabled():
+        return
+    with _plans_lock:
+        _advisories.append(dict(data))
+        del _advisories[:-64]
+    # the ring event's own kind is "advisory"; the diagnosis kind rides
+    # as advisory_kind (same convention as note_fault's fault_kind)
+    record("advisory", **{
+        ("advisory_kind" if k == "kind" else k): v
+        for k, v in data.items()
+    })
 
 
 def _clock_triple() -> dict:
@@ -343,6 +372,7 @@ def _build_dump(reason: str) -> dict:
     with _plans_lock:
         out["comm_plans"] = list(_plans)
         out["fault_events"] = list(_faults)
+        out["advisories"] = list(_advisories)
     try:
         out["metrics"] = metrics_mod.snapshot()
     except Exception:

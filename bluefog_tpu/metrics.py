@@ -33,7 +33,10 @@ metrics-off (tests/test_metrics.py).
 **Host tier** — a process-wide registry of counters / gauges /
 histograms fed by the runtime itself: comm-plan compile cache hits and
 misses, XLA program (re)compiles, ppermute rounds and wire bytes per
-gossip step, window-op counts, and watchdog stall events.
+gossip step, window-op counts, and watchdog stall events. The
+attribution doctor (:mod:`bluefog_tpu.attribution`) both reads this
+tier (counter deltas via :func:`peek`) and feeds it back
+(``bluefog.doctor.*`` gauges and advisory counters).
 
 Exporters (all three can run at once):
 
@@ -67,6 +70,7 @@ __all__ = [
     "gauge",
     "histogram",
     "snapshot",
+    "peek",
     "reset",
     "enabled",
     "metrics_interval",
@@ -196,6 +200,17 @@ def snapshot() -> dict:
     with _lock:
         items = sorted(_registry.items())
     return {name: s.describe() for name, s in items}
+
+
+def peek(name: str):
+    """The registered series object, or None when nothing has written
+    it yet. Read-only consumers (the attribution doctor's counter-delta
+    and gauge reads, :mod:`bluefog_tpu.attribution`) use this instead of
+    :func:`counter`/:func:`gauge`, which would CREATE an empty series —
+    a snapshot polluted with never-written zeros is indistinguishable
+    from measured zeros."""
+    with _lock:
+        return _registry.get(name)
 
 
 def reset() -> None:

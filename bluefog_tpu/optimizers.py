@@ -46,6 +46,7 @@ worker-stacked pytrees (leading axis = worker), the same convention as
 
 import enum
 import itertools
+import time
 from typing import Optional
 
 import numpy as np
@@ -54,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from bluefog_tpu import attribution
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
@@ -428,6 +430,10 @@ class _GossipOptimizer:
         self._pending_drain = None  # (wire, payload) copying to host
         self._metrics_hooked = False
         self._acct_cache: dict = {}  # per-program wire-byte accounting
+        # The CommPlan behind the most recent gossip resolution (None
+        # for allreduce/empty/hierarchical): the attribution doctor's
+        # per-round probes measure exactly this plan's rounds.
+        self._last_plan = None
 
     @property
     def tx(self):
@@ -522,6 +528,7 @@ class _GossipOptimizer:
         chunk/route change compiles its own program.
         """
         comm = self.communication_type
+        self._last_plan = None
         if self.schedule is not None and comm not in (
             CommunicationType.neighbor_allreduce,
             CommunicationType.hierarchical_neighbor_allreduce,
@@ -553,6 +560,10 @@ class _GossipOptimizer:
                     # deduped: the whole period lands in the postmortem
                     # side table once, however many steps dispatch
                     flight.note_plan(p, ctx.topo_version, ctx.live_token())
+                # the doctor probes whichever plan THIS step dispatches
+                self._last_plan = sched.plans[
+                    self._comm_count % sched.period
+                ]
                 return (
                     (sched,),
                     lambda t, step, wops: inner.neighbor_allreduce_step(
@@ -567,6 +578,7 @@ class _GossipOptimizer:
                 self.dst_weights,
                 self.enable_topo_check,
             )
+            self._last_plan = plan
             perms = plan.perms
             info = plan.compile_info
             inject = info.inject if info is not None else None
@@ -705,6 +717,9 @@ class _GossipOptimizer:
         weights) or a dynamic machine-level SchedulePlan (the reference's
         GetExp2DynamicSendRecvMachineRanks training pattern,
         examples/pytorch_benchmark.py:182-202)."""
+        # machine-mesh rounds are not probeable on the worker mesh: the
+        # doctor keeps step-level attribution, skips per-round profiling
+        self._last_plan = None
         if self.schedule is not None:
             sched = self.schedule
             if sched.size != ctx.machine_size:
@@ -1056,11 +1071,24 @@ class _GossipOptimizer:
         ef_in = self._ef if ef else ()
         if met_enabled:
             self._record_comm_accounting(key, gossip_key, params, ctx)
+        doc_t0 = attribution.dispatch_timer(comm_now)
         params_out, opt_state, ef_out, met_out = _timed_dispatch(
             "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
             ef_in,
         )
         flight.record("step_dispatched", step=self._step_count - 1)
+        if comm_now:
+            # attribution doctor (BLUEFOG_DOCTOR): purely host-side
+            # observation — the dispatched program above is untouched
+            attribution.observe_step(
+                ctx, step=self._step_count - 1, outputs=params_out,
+                plan=self._last_plan, params=params,
+                wire=self.compression,
+                dispatch_s=(
+                    time.perf_counter() - doc_t0
+                    if doc_t0 is not None else None
+                ),
+            )
         if ef:
             self._ef = ef_out
         if met:
@@ -1365,6 +1393,7 @@ class _GossipOptimizer:
                 )
                 for op in (wops, ef_in, buf_in, accum_in)
             )
+            doc_t0 = attribution.dispatch_timer(comm_now)
             if self.order == "grad" and not comm_now:
                 params_o, state_o, loss, aux, _ef_o, grads_o, _met_o = (
                     _timed_dispatch(
@@ -1398,6 +1427,17 @@ class _GossipOptimizer:
                         None if delay_now else wire_now, met_o[0]
                     )
             flight.record("step_dispatched", step=self._step_count - 1)
+            if comm_now:
+                # attribution doctor: host-side only, program untouched
+                attribution.observe_step(
+                    ctx, step=self._step_count - 1, outputs=loss,
+                    plan=self._last_plan, params=params,
+                    wire=self.compression,
+                    dispatch_s=(
+                        time.perf_counter() - doc_t0
+                        if doc_t0 is not None else None
+                    ),
+                )
             if has_aux:
                 return params_o, state_o, (loss, aux)
             return params_o, state_o, loss

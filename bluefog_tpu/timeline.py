@@ -36,6 +36,7 @@ __all__ = [
     "timeline_end_activity",
     "timeline_record_complete",
     "timeline_record_instant",
+    "timeline_record_advisory",
     "timeline_record_counter",
     "timeline_context",
     "process_file_index",
@@ -311,6 +312,22 @@ def timeline_record_instant(name: str, activity: str = "", rank: int = 0,
         name.encode(), activity.encode(), b"i", rank, tid
     )
     return True
+
+
+def timeline_record_advisory(kind: str, detail: Optional[dict] = None,
+                             rank: int = 0) -> bool:
+    """One ``ph:"i"`` instant for a doctor advisory
+    (:mod:`bluefog_tpu.attribution`), named ``doctor:<kind> <k=v ...>``
+    so the diagnosis reads directly off the trace next to the spans it
+    explains. The detail is flattened into the name (instant events
+    carry no args through the native writer's record layout)."""
+    parts = "".join(
+        f" {k}={v}" for k, v in sorted((detail or {}).items())
+        if isinstance(v, (int, float, str, list, tuple))
+    )
+    return timeline_record_instant(
+        f"doctor:{kind}{parts}", "ADVISORY", rank
+    )
 
 
 def timeline_record_counter(name: str, value: float,

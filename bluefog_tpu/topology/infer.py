@@ -20,16 +20,17 @@ __all__ = [
 
 
 def _check_ranks(rank_list: Sequence[Any], self_rank: int, size: int) -> Tuple[bool, str]:
-    # Validation parity: reference torch/topology_util.py:9-19.
+    # Validation parity with reference torch/topology_util.py:9-19 (same
+    # four rules, same ordering; messages are this port's own wording).
     for rank in rank_list:
         if not isinstance(rank, (int, np.integer)):
-            return False, "contain element that is not integer."
+            return False, "has a non-integer entry."
         if rank < 0 or rank >= size:
-            return False, "contain element that is not between 0 and size-1."
+            return False, "has an entry outside the valid range [0, size)."
     if len(set(rank_list)) != len(rank_list):
-        return False, "contain duplicated elements."
+        return False, "lists the same rank more than once."
     if self_rank in rank_list:
-        return False, "contain self rank."
+        return False, "includes the rank itself as its own peer."
     return True, ""
 
 

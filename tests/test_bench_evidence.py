@@ -382,3 +382,125 @@ def test_gossip_overhead_regression():
     ]
     assert combined, lines
     assert combined[0]["overhead_pct_vs_bs64_step"] < 10.0, lines
+
+
+def _bench_mod():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_row_validator_rejects_impossible_rows():
+    """The row sanity validator (VERDICT #2): non-positive times and a
+    fwd+bwd undercutting its own fwd are violations; plausible and
+    degenerate-disclosed rows pass. run_flash wires this as
+    reject+remeasure, so the r05 impossible rows cannot ship again."""
+    bench = _bench_mod()
+    ok = {
+        "metric": "flash_attention_vs_dense",
+        "flash_fwd_ms": 1.0, "flash_fwdbwd_ms": 3.0,
+        "dense_fwd_ms": 2.0, "dense_fwdbwd_ms": 6.0,
+    }
+    assert bench.bench_row_problems(ok) == []
+    impossible = dict(ok, dense_fwdbwd_ms=0.0)
+    probs = bench.bench_row_problems(impossible)
+    assert any("not a positive time" in p for p in probs)
+    inverted = dict(ok, dense_fwdbwd_ms=1.5)  # fwdbwd < fwd
+    probs = bench.bench_row_problems(inverted)
+    assert any("cannot be faster" in p for p in probs)
+    # rows already disclosed as degenerate are exempt (artifact, not
+    # measurement)
+    assert bench.bench_row_problems(dict(impossible, degenerate=True)) == []
+
+
+def test_attribution_evidence_file_committed():
+    """ATTRIBUTION_EVIDENCE.json (the committed BENCH_MODE=attribution
+    output) carries the acceptance facts: <=1% overhead at the default
+    interval with the A/A control disclosed, the structural
+    shared-cache-key pin, the bitwise on/off pin, a decomposition
+    sample, the degraded-link advisory naming the injected edge, and
+    the ambient-anchor line."""
+    path = os.path.join(REPO, "ATTRIBUTION_EVIDENCE.json")
+    assert os.path.exists(path), "ATTRIBUTION_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    overhead = [
+        l for l in lines if l.get("metric") == "attribution_overhead"
+    ]
+    assert overhead, lines
+    assert overhead[0]["overhead_pct"] <= 1.0
+    assert "control_aa_pct" in overhead[0]
+    assert overhead[0]["unsampled_program_shared"] is True
+    assert overhead[0]["bitwise_identical"] is True
+    sample = [
+        l for l in lines if l.get("metric") == "attribution_sample"
+    ]
+    assert sample and sample[0]["comm_wire_ms"] > 0
+    link = [
+        l for l in lines if l.get("metric") == "attribution_degraded_link"
+    ]
+    assert link and link[0]["named_correctly"] is True
+    assert link[0]["injected_edge"] in link[0]["edges_named"]
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_every_committed_evidence_keeps_anchor_contract():
+    """New rounds' artifacts must carry the ambient anchor; this pins
+    the contract on the one artifact this PR commits (older artifacts
+    predate it — bench_diff reports them as lacking an anchor rather
+    than failing)."""
+    path = os.path.join(REPO, "ATTRIBUTION_EVIDENCE.json")
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    anchors = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert len(anchors) == 1
+    assert anchors[0]["dtype"] == "bfloat16" and anchors[0]["n"] >= 512
+
+
+def test_bench_diff_classifies_ambient_vs_real(tmp_path):
+    """tools/bench_diff.py consumes the anchor: a headline whose value
+    moved but whose anchor-normalized vs_anchor held still is AMBIENT;
+    one that survives normalization is REAL."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, tflops, value, windows=True):
+        rows = [
+            prov,
+            {"metric": "ambient_anchor", "n": 512,
+             "dtype": "bfloat16", "tflops": tflops},
+            {"metric": "resnet50_bs64_imgs_per_sec_per_chip",
+             "value": value, "unit": "imgs/sec/chip",
+             "vs_anchor": round(value / tflops, 3),
+             "median": value * 0.98, "min": value * 0.97,
+             "windows": 8},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(path)
+
+    # ambient: the host slowed 10% and the headline followed it
+    a = artifact(tmp_path / "a.json", 100.0, 2800.0)
+    b = artifact(tmp_path / "b.json", 90.0, 2520.0)
+    rep = compare(a, b, [])
+    assert rep["ambient_anchor_delta_pct"] == -10.0
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert cell["headline_delta_class"].startswith("ambient"), cell
+    # real: the headline dropped 10% on an unmoved host
+    c = artifact(tmp_path / "c.json", 100.0, 2520.0)
+    rep2 = compare(a, c, [])
+    cell2 = [c2 for c2 in rep2["cells"] if c2["status"] == "paired"][0]
+    assert cell2["headline_delta_class"].startswith("real"), cell2

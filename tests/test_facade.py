@@ -324,3 +324,104 @@ def test_resnet_family_shapes():
         assert out.shape == (1, 10)
         n = sum(p.size for p in jax.tree_util.tree_leaves(v["params"]))
         assert abs(n - approx) / approx < 0.05, (name, n)
+
+
+# -- fire-and-forget handle reclamation (VERDICT Weak #6) ---------------------
+
+
+def test_handle_map_bounded_under_fire_and_forget():
+    """10k unsynchronized nonblocking ops must not grow the handle map
+    without bound: once past the reap threshold, each new dispatch
+    reclaims the oldest READY results (the abandoned ones). The bulk of
+    the pressure uses host-side ready stand-ins (dispatching 10k real
+    programs is pure test latency); a real-op smoke closes the loop."""
+    from bluefog_tpu.collective import ops as col_ops
+
+    class Ready:
+        def is_ready(self):
+            return True
+
+    baseline = len(col_ops._handle_map)
+    handles = [col_ops._new_handle(Ready()) for _ in range(10_000)]
+    assert len(col_ops._handle_map) <= (
+        col_ops._HANDLE_REAP_THRESHOLD + baseline + 1
+    )
+    # a reclaimed handle polls True (its result WAS ready) and
+    # synchronize reports the reclamation instead of a bare KeyError
+    assert bf.poll(handles[0])
+    with pytest.raises(ValueError, match="reclaimed"):
+        bf.synchronize(handles[0])
+    # the newest handle survived and synchronizes normally
+    assert col_ops._handle_map.pop(handles[-1], None) is not None
+
+    # real ops: a burst of nonblocking dispatches stays bounded, and a
+    # recent handle still returns the right value
+    x = bf.worker_values(lambda r: np.full((4,), float(r), np.float32))
+    hs = [bf.allreduce_nonblocking(x) for _ in range(40)]
+    assert len(col_ops._handle_map) <= col_ops._HANDLE_REAP_THRESHOLD + 1
+    out = bf.synchronize(hs[-1])
+    np.testing.assert_allclose(
+        np.asarray(out)[0], np.full((4,), np.mean(range(SIZE))),
+        rtol=1e-6,
+    )
+    for h in hs[:-1]:  # drain what survived
+        col_ops._handle_map.pop(h, None)
+
+
+# -- per-op neighbor-list validation cache (VERDICT Weak #7) ------------------
+
+
+def test_in_neighbor_sets_cached_on_topo_version():
+    ctx = bf.get_context()
+    first = ctx.in_neighbor_sets()
+    # warm path: same object back, no recompute
+    assert ctx.in_neighbor_sets() is first
+    assert first[0] == frozenset(bf.in_neighbor_ranks(0))
+    # a topology change invalidates exactly once
+    bf.set_topology(bf.topology.RingGraph(SIZE))
+    second = ctx.in_neighbor_sets()
+    assert second is not first
+    assert second[0] == frozenset(bf.in_neighbor_ranks(0))
+    assert ctx.in_neighbor_sets() is second
+
+
+def test_explicit_weights_hot_path_host_cost_pinned():
+    """Pin the eager explicit-weights path's per-call host validation at
+    the north-star scale (256 ranks), mirroring
+    test_windows.py::test_host_weight_resolution_cost: after the first
+    call builds the topo_version-keyed neighbor sets, repeated
+    validation is O(keys) — the graph is never walked again."""
+    import time
+    import types
+
+    from bluefog_tpu import context as ctx_mod
+
+    size = 256
+    g = bf.topology.ExponentialTwoGraph(size)
+    ctx = types.SimpleNamespace(
+        size=size, _topology=g, topo_version=1,
+        _neighbor_sets_cache=None,
+    )
+    t0 = time.perf_counter()
+    sets = ctx_mod.BluefogContext.in_neighbor_sets(ctx)
+    cold_s = time.perf_counter() - t0
+    assert len(sets) == size
+
+    # the validation body _resolve_plan runs per call, against the
+    # cached sets (one entry per rank, subset check per rank)
+    per_rank = [dict.fromkeys(s, 0.1) for s in sets]
+
+    def validate_once():
+        in_sets = ctx_mod.BluefogContext.in_neighbor_sets(ctx)
+        for r, entry in enumerate(per_rank):
+            assert set(entry.keys()).issubset(in_sets[r])
+
+    validate_once()
+    t0 = time.perf_counter()
+    for _ in range(50):
+        validate_once()
+    warm_s = (time.perf_counter() - t0) / 50
+    # generous CI bound (measured ~0.2 ms at 256 ranks); the load-bearing
+    # assertion is identity: the cache is returned, never rebuilt
+    assert warm_s < 0.01, (warm_s, cold_s)
+    assert ctx_mod.BluefogContext.in_neighbor_sets(ctx) is sets

@@ -19,7 +19,11 @@ run's own disclosed noise floor. This tool mechanizes that judgment:
 - computes per-cell deltas with a noise floor taken from the
   measurements' own disclosed spread (best-of-N ``value``/``median``/
   ``min`` windows, ``aa_noise_pct`` A/A lines) — a delta inside the
-  floor is reported as noise, not regression.
+  floor is reported as noise, not regression;
+- consumes the ``ambient_anchor`` line each round emits (fixed bf16
+  matmul TFLOP/s) to classify headline deltas: a ``value`` that moved
+  while its anchor-normalized ``vs_anchor`` held still is AMBIENT host
+  drift; a delta that survives anchor normalization is real.
 
 ``--check`` exits nonzero when either artifact is structurally unusable
 (no JSON lines, ambiguous duplicate cells), the mode CI wires in so
@@ -48,7 +52,20 @@ CONFIG_INT_KEYS = {
 }
 
 # Harness metadata: neither identity nor a measurement to diff.
-HARNESS_KEYS = {"windows", "degenerate", "degenerate_cells", "unit"}
+# anchor_tflops is the run-level ambient anchor replicated into the
+# headline cell — diffing it as a measurement would report pure host
+# drift as "deltas beyond the noise floor" while the classifier
+# simultaneously (and correctly) calls the same movement ambient.
+HARNESS_KEYS = {
+    "windows", "degenerate", "degenerate_cells", "unit",
+    "harness_validation", "rejected", "anchor_tflops",
+}
+
+# Derived normalization fields that arrived WITH the anchor feature:
+# absent from every pre-anchor artifact, so a one-sided appearance is
+# the tooling gaining a column, not a timing-harness change — it only
+# disables ambient classification for that pair.
+ANCHOR_DERIVED = {"vs_anchor"}
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
 
@@ -128,9 +145,17 @@ def noise_floor_pct(obj: dict) -> Optional[float]:
 def build_cells(lines: List[dict], problems: List[str], path: str):
     cells: Dict[Tuple, dict] = {}
     provenance = None
+    anchor = None
     for obj in lines:
         if obj.get("metric") == "provenance":
             provenance = obj
+            continue
+        if obj.get("metric") == "ambient_anchor":
+            # the ambient-drift anchor is run metadata, like
+            # provenance: consumed for delta classification, never
+            # diffed as a cell
+            if isinstance(obj.get("tflops"), (int, float)):
+                anchor = obj
             continue
         key = cell_identity(obj)
         if key in cells:
@@ -139,7 +164,36 @@ def build_cells(lines: List[dict], problems: List[str], path: str):
                 "ambiguous pairing"
             )
         cells[key] = obj
-    return cells, provenance
+    return cells, provenance, anchor
+
+
+def classify_ambient(entry: dict, floor: Optional[float],
+                     anchor_delta_pct: Optional[float]) -> None:
+    """Classify a headline delta as ambient vs real using the anchor
+    (ROADMAP item 1 / VERDICT "Next round" #1): ``vs_anchor`` is the
+    headline normalized by the run's own ambient-compute anchor, so a
+    ``value`` that moved while ``vs_anchor`` held still is the HOST
+    moving, not the code. Writes ``headline_delta_class`` onto the
+    entry when both fields were diffed."""
+    deltas = entry.get("deltas", {})
+    dv = deltas.get("value")
+    da = deltas.get("vs_anchor")
+    if dv is None or da is None or dv.get("delta_pct") is None or (
+        da.get("delta_pct") is None
+    ):
+        return
+    eff_floor = max(floor if floor is not None else 0.0, 2.0)
+    value_moved = abs(dv["delta_pct"]) > eff_floor
+    anchored_moved = abs(da["delta_pct"]) > eff_floor
+    if not value_moved:
+        cls = "noise (value within floor)"
+    elif not anchored_moved:
+        cls = "ambient (value tracks the anchor: host drift)"
+    else:
+        cls = "real (delta survives anchor normalization)"
+    entry["headline_delta_class"] = cls
+    if anchor_delta_pct is not None:
+        entry["ambient_anchor_delta_pct"] = round(anchor_delta_pct, 2)
 
 
 def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
@@ -147,8 +201,13 @@ def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
     lines_a, pa = parse_artifact(path_a)
     lines_b, pb = parse_artifact(path_b)
     problems += pa + pb
-    cells_a, prov_a = build_cells(lines_a, problems, path_a)
-    cells_b, prov_b = build_cells(lines_b, problems, path_b)
+    cells_a, prov_a, anchor_a = build_cells(lines_a, problems, path_a)
+    cells_b, prov_b, anchor_b = build_cells(lines_b, problems, path_b)
+    anchor_delta_pct = None
+    if anchor_a and anchor_b and anchor_a.get("n") == anchor_b.get("n"):
+        ta, tb = anchor_a["tflops"], anchor_b["tflops"]
+        if ta:
+            anchor_delta_pct = (tb - ta) / ta * 100.0
 
     incomparable: List[str] = []
     if prov_a is None:
@@ -188,7 +247,8 @@ def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
             continue
         va, vb = cell_values(a), cell_values(b)
         shared = sorted(set(va) & set(vb))
-        only_a, only_b = sorted(set(va) - set(vb)), sorted(set(vb) - set(va))
+        only_a = sorted(set(va) - set(vb) - ANCHOR_DERIVED)
+        only_b = sorted(set(vb) - set(va) - ANCHOR_DERIVED)
         floors = [
             f for f in (noise_floor_pct(a), noise_floor_pct(b))
             if f is not None
@@ -213,6 +273,7 @@ def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
             None if floor is None else round(floor, 2)
         )
         entry["deltas"] = deltas
+        classify_ambient(entry, floor, anchor_delta_pct)
         if only_a or only_b:
             entry["fields_only_in_one"] = {
                 "a": only_a, "b": only_b,
@@ -248,6 +309,12 @@ def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
         "b": path_b,
         "provenance_a": prov_a,
         "provenance_b": prov_b,
+        "ambient_anchor_a": anchor_a,
+        "ambient_anchor_b": anchor_b,
+        "ambient_anchor_delta_pct": (
+            None if anchor_delta_pct is None
+            else round(anchor_delta_pct, 2)
+        ),
         "comparability_problems": incomparable,
         "structural_problems": problems,
         "cells": report_cells,
@@ -290,6 +357,11 @@ def main(argv=None) -> int:
                 print(f"  {name} {cfg}: only in {cell['present_in']}")
                 continue
             print(f"  {name} {cfg}: {cell['verdict']}")
+            if cell.get("headline_delta_class"):
+                print(
+                    f"    anchor classification: "
+                    f"{cell['headline_delta_class']}"
+                )
             for k, d in cell.get("deltas", {}).items():
                 if d.get("delta_pct") is not None:
                     print(

@@ -1,0 +1,298 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Fuse attribution + metrics + flight artifacts into one triage report.
+
+The observability stack leaves three kinds of evidence on disk: the
+attribution doctor's dump (``bf.doctor`` sample/advisory history,
+:mod:`bluefog_tpu.attribution`), the metrics JSONL
+(``BLUEFOG_METRICS_FILE``), and flight-recorder dumps
+(``flight_<proc>.json``). Each answers a different question; a 3 a.m.
+triage needs them joined: *"step time grew 12 % at step 4100: exposed
+comm on edge 3->7 rose 4x over the model prediction; advisory
+degraded_link fired; the flight dump from rank 3 shows the verdict."*
+This tool produces exactly that sentence (and its JSON form) from the
+COMMITTED artifacts alone — no live run, no devices, no jax import.
+
+Usage::
+
+    python tools/doctor.py --attribution doctor_dump.json \
+        [--metrics run.jsonl] [--flight flight_dir_or_files...] \
+        [--json] [--out report.json]
+
+The report contains:
+
+- ``step_time_trend`` — the largest step-time movement across the
+  sample history (early-window median vs late-window median), with the
+  growth attributed per component (comm_wire / compute / dispatch) by
+  the same windowed comparison;
+- ``suspect_rounds`` — rounds (and drilled-down edges) whose
+  measured/predicted residual stands out in the latest samples;
+- ``advisories`` — the advisory history from the doctor dump, joined
+  with advisory events found in flight dumps (so a dump written by a
+  crash trigger corroborates what the doctor saw live);
+- ``metrics`` — last-known doctor gauges and gossip-health series from
+  the metrics JSONL;
+- ``summary`` — the human sentences, most damning first.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _median(vals):
+    # lower median: an even-length list with one outlier must not
+    # return the outlier itself (the suspect-round gate divides by this)
+    vals = sorted(vals)
+    return vals[(len(vals) - 1) // 2] if vals else None
+
+
+def load_attribution(path: str) -> dict:
+    with open(path) as f:
+        dump = json.load(f)
+    if dump.get("kind") != "doctor_dump":
+        raise ValueError(
+            f"{path} is not an attribution dump (expected kind="
+            f"'doctor_dump', got {dump.get('kind')!r})"
+        )
+    return dump
+
+
+def load_metrics_jsonl(path: str) -> List[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def load_flight_dumps(paths: List[str]) -> List[dict]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += sorted(glob.glob(os.path.join(p, "flight_*.json")))
+        else:
+            files.append(p)
+    dumps = []
+    for fp in files:
+        try:
+            with open(fp) as f:
+                d = json.load(f)
+            d["_path"] = fp
+            dumps.append(d)
+        except (OSError, ValueError):
+            continue
+    return dumps
+
+
+def step_time_trend(samples: List[dict], window: int = 4) -> Optional[dict]:
+    """Early-window vs late-window medians of the decomposed series:
+    where did the step time go, in which component?"""
+    rows = [s for s in samples if s.get("step_ms") is not None]
+    if len(rows) < 2:
+        return None
+    w = max(1, min(window, len(rows) // 2))
+    early, late = rows[:w], rows[-w:]
+
+    def delta(key):
+        a = _median([r[key] for r in early if r.get(key) is not None])
+        b = _median([r[key] for r in late if r.get(key) is not None])
+        if a is None or b is None:
+            return None
+        return {
+            "early_ms": round(a, 3), "late_ms": round(b, 3),
+            "delta_ms": round(b - a, 3),
+            "delta_pct": round((b - a) / a * 100.0, 1) if a else None,
+        }
+
+    out = {
+        "window": w,
+        "at_step": late[0].get("step"),
+        "step": delta("step_ms"),
+        "comm_wire": delta("comm_wire_ms"),
+        "compute": delta("compute_ms"),
+        "dispatch": delta("dispatch_ms"),
+    }
+    comp = {
+        k: v["delta_ms"] for k, v in out.items()
+        if isinstance(v, dict) and k != "step"
+        and v.get("delta_ms") is not None
+    }
+    if comp:
+        out["dominant_component"] = max(comp, key=lambda k: comp[k])
+    anchors = [
+        s["anchor_tflops"] for s in samples
+        if s.get("anchor_tflops") is not None
+    ]
+    if len(anchors) >= 2 * w:
+        a, b = _median(anchors[:w]), _median(anchors[-w:])
+        out["anchor"] = {
+            "early_tflops": round(a, 4), "late_tflops": round(b, 4),
+            "delta_pct": round((b - a) / a * 100.0, 1) if a else None,
+        }
+    return out
+
+
+def suspect_rounds(samples: List[dict], ratio: float = 3.0) -> List[dict]:
+    """Rounds (latest samples win) whose measured/predicted residual
+    exceeds ``ratio``, with any per-edge drill-down attached."""
+    latest: Dict[int, dict] = {}
+    for s in samples:
+        for r in s.get("rounds", []):
+            latest[r["round"]] = {**r, "step": s.get("step")}
+    out = []
+    med = _median([r["probe_ms"] for r in latest.values()]) or 0.0
+    for r in sorted(latest.values(), key=lambda r: -r["residual_ratio"]):
+        if r["residual_ratio"] >= ratio and r["probe_ms"] >= ratio * med:
+            out.append(r)
+    return out
+
+
+def triage(attribution: dict, metrics_rows: List[dict],
+           flight_dumps: List[dict]) -> dict:
+    samples = attribution.get("samples", [])
+    advisories = list(attribution.get("advisories", []))
+
+    flight_advisories = []
+    dump_reasons = []
+    for d in flight_dumps:
+        base = os.path.basename(d.get("_path", "?"))
+        for a in d.get("advisories", []):
+            flight_advisories.append({**a, "dump": base})
+        for r in d.get("dump_history", []):
+            dump_reasons.append({"dump": base, "reason": r})
+
+    trend = step_time_trend(samples)
+    suspects = suspect_rounds(samples)
+
+    doctor_series = {}
+    gossip_series = {}
+    if metrics_rows:
+        last = metrics_rows[-1].get("metrics", {})
+        for name, desc in last.items():
+            val = desc.get("value", desc.get("last"))
+            if name.startswith("bluefog.doctor."):
+                doctor_series[name] = val
+            elif name.startswith("bluefog.gossip."):
+                gossip_series[name] = val
+
+    summary: List[str] = []
+    if trend and trend.get("step") and trend["step"].get("delta_pct"):
+        pct = trend["step"]["delta_pct"]
+        if abs(pct) >= 5.0:
+            dom = trend.get("dominant_component")
+            sentence = (
+                f"step time {'grew' if pct > 0 else 'shrank'} "
+                f"{abs(pct):.0f}% around step {trend['at_step']} "
+                f"({trend['step']['early_ms']} -> "
+                f"{trend['step']['late_ms']} ms)"
+            )
+            if pct > 0 and dom:
+                dv = trend[dom]
+                sentence += (
+                    f": {dom.replace('_', ' ')} rose "
+                    f"{dv['delta_ms']:+.3f} ms"
+                )
+            anchor = trend.get("anchor")
+            if anchor and anchor.get("delta_pct") is not None and (
+                abs(anchor["delta_pct"]) >= 5.0
+            ):
+                sentence += (
+                    f"; ambient anchor moved {anchor['delta_pct']:+.1f}% "
+                    "(host drift, not the program)"
+                )
+            summary.append(sentence)
+    for r in suspects[:3]:
+        edges = r.get("edge_probe_ms")
+        if edges:
+            worst = max(edges, key=lambda e: edges[e])
+            summary.append(
+                f"round {r['round']} measured {r['probe_ms']} ms vs "
+                f"{r['predicted_ms']} ms predicted "
+                f"({r['residual_ratio']}x); edge {worst} is the slow "
+                f"link at {edges[worst]} ms"
+            )
+        else:
+            summary.append(
+                f"round {r['round']} measured {r['probe_ms']} ms vs "
+                f"{r['predicted_ms']} ms predicted "
+                f"({r['residual_ratio']}x over the model)"
+            )
+    for a in advisories[-5:]:
+        detail = {
+            k: v for k, v in a.items() if k not in ("kind", "step")
+        }
+        summary.append(
+            f"advisory {a.get('kind')} fired at step {a.get('step')}: "
+            + json.dumps(detail)
+        )
+    for r in dump_reasons[-3:]:
+        summary.append(
+            f"flight dump {r['dump']} was triggered by: {r['reason']}"
+        )
+    if not summary:
+        summary.append(
+            "no anomaly stands out: step-time trend flat, rounds track "
+            "the model, no advisories on record"
+        )
+
+    return {
+        "kind": "doctor_triage",
+        "samples": len(samples),
+        "interval": attribution.get("interval"),
+        "calibration": attribution.get("calibration"),
+        "step_time_trend": trend,
+        "suspect_rounds": suspects,
+        "advisories": advisories,
+        "flight_advisories": flight_advisories,
+        "flight_dump_reasons": dump_reasons,
+        "doctor_metrics": doctor_series,
+        "gossip_metrics": gossip_series,
+        "summary": summary,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--attribution", required=True,
+                    help="doctor dump JSON (bf.doctor dump / "
+                         "attribution.dump())")
+    ap.add_argument("--metrics", help="BLUEFOG_METRICS_FILE JSONL")
+    ap.add_argument("--flight", nargs="*", default=[],
+                    help="flight dump files or directories")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report")
+    ap.add_argument("--out", help="also write the JSON report here")
+    args = ap.parse_args(argv)
+
+    attribution = load_attribution(args.attribution)
+    metrics_rows = (
+        load_metrics_jsonl(args.metrics) if args.metrics else []
+    )
+    flight_dumps = load_flight_dumps(args.flight)
+    report = triage(attribution, metrics_rows, flight_dumps)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"doctor triage: {args.attribution} "
+              f"({report['samples']} samples)")
+        for line in report["summary"]:
+            print(f"  - {line}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,14 +52,27 @@ def emit(**kw):
     print(json.dumps(kw), flush=True)
 
 
+def matmul_tflops(n: int, iters: int = 10, warmup: int = 3) -> float:
+    """Dense bf16 ``n x n`` matmul throughput in TFLOP/s — the fixed
+    roofline anchor. ``bench.py`` emits this (8192 on TPU) as the
+    ambient-drift anchor line every ``BENCH_MODE`` carries, so
+    cross-round headline deltas are classifiable as ambient host drift
+    vs real change (``tools/bench_diff.py`` consumes it); the
+    attribution doctor times a miniature of the same anchor per sample
+    (:mod:`bluefog_tpu.attribution`)."""
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    dt = timed(f, a, b, iters=iters, warmup=warmup)
+    return 2 * n ** 3 / dt / 1e12
+
+
 def probe_matmul():
     for n in (4096, 8192):
-        a = jnp.ones((n, n), jnp.bfloat16)
-        b = jnp.ones((n, n), jnp.bfloat16)
-        f = jax.jit(lambda a, b: a @ b)
-        dt = timed(f, a, b)
-        emit(probe="matmul", n=n, ms=round(dt * 1e3, 3),
-             tflops=round(2 * n**3 / dt / 1e12, 1))
+        dt_tflops = matmul_tflops(n)
+        emit(probe="matmul", n=n,
+             ms=round(2 * n**3 / dt_tflops / 1e9, 3),
+             tflops=round(dt_tflops, 1))
 
 
 def probe_dispatch():
