@@ -54,6 +54,13 @@ Individual families via ``BENCH_MODE``:
   a sample's compute/comm/host decomposition, and a fault-plan
   degraded-link scenario where the emitted advisory must name the
   injected edge. Committed as ATTRIBUTION_EVIDENCE.json.
+- ``quant``: quantized-wire evidence — every wire tier
+  (fp32/bf16/int8/int8_ef/int4/int4_ef) on one pure-consensus problem,
+  per-tier wire bytes with the block-scale sidecar priced in,
+  consensus-distance curves, quant-error telemetry, and the push-sum
+  mass-conservation check under ``BLUEFOG_WINDOW_WIRE=int4``; asserts
+  the >=2x wire-reduction-vs-int8 claim at int8-or-better consensus
+  quality. Committed as QUANT_EVIDENCE.json.
 
 Every run additionally emits an **ambient-drift anchor** line
 (``{"metric": "ambient_anchor"}``: the fixed dense bf16 matmul TFLOP/s
@@ -2403,6 +2410,247 @@ def run_flash() -> int:
     return 0
 
 
+def run_quant() -> int:
+    """Quantized-wire evidence (``BENCH_MODE=quant``, committed as
+    QUANT_EVIDENCE.json): the full wire-tier family —
+    fp32/bf16/int8/int8_ef/int4/int4_ef — run on the same pure-consensus
+    problem (zero gradients isolate the wire's noise from optimizer
+    bias), with per-tier wire bytes (scale sidecar priced in), the
+    consensus-distance curve, and the metrics tier's quant-error
+    telemetry. The headline claim this artifact gates (``BENCH_ASSERT``,
+    default on): the int4 tiers ship >= 2x fewer wire bytes than int8,
+    and ``int4_ef`` reaches consensus quality no worse than int8's
+    (within the disclosed multi-seed A/A spread — error feedback erases
+    the coarser quantizer's floor, so it typically lands ORDERS below).
+    A push-sum window run under ``BLUEFOG_WINDOW_WIRE=int4`` closes the
+    artifact with the sender-mass-conservation check (drift bounded by
+    f32 rounding, not quantization: the sender absorbs the residual of
+    the mass it ships — docs/windows.md)."""
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_QUANT_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import metrics as bf_metrics
+    from bluefog_tpu import scaling
+    from bluefog_tpu import windows as win_mod
+    from bluefog_tpu.collective.plan import plan_from_topology
+
+    n = min(len(jax.devices()),
+            int(os.environ.get("BENCH_QUANT_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_QUANT_DIM", "4096"))
+    steps = int(os.environ.get("BENCH_QUANT_STEPS", "200"))
+    seeds = max(2, int(os.environ.get("BENCH_QUANT_SEEDS", "3")))
+    curve_every = max(1, steps // 20)
+
+    plan = plan_from_topology(topo.ExponentialTwoGraph(n), weighted=True)
+    tiers = (None, "bf16", "int8", "int8_ef", "int4", "int4_ef")
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_METRICS", "BLUEFOG_METRICS_INTERVAL",
+                  "BLUEFOG_METRICS_FILE", "BLUEFOG_METRICS_PROM",
+                  "BLUEFOG_WINDOW_WIRE")
+    }
+    os.environ.pop("BLUEFOG_METRICS_FILE", None)
+    os.environ.pop("BLUEFOG_METRICS_PROM", None)
+    os.environ["BLUEFOG_METRICS"] = "1"
+    os.environ["BLUEFOG_METRICS_INTERVAL"] = "1"
+
+    def consensus_dist(w):
+        return float(
+            np.sqrt(((w - w.mean(0)) ** 2).sum(1)).mean()
+        )
+
+    finals = {}
+    try:
+        bf.init(devices=jax.devices()[:n])
+        bf.set_topology(topo.ExponentialTwoGraph(n))
+        for wire in tiers:
+            name = wire or "fp32"
+            curves = []
+            quant_err = None
+            for seed in range(seeds):
+                bf_metrics.reset()
+                c = (
+                    np.random.RandomState(100 + seed)
+                    .randn(n, dim).astype(np.float32) * 5.0
+                )
+                opt = bf.DistributedNeighborAllreduceOptimizer(
+                    optax.sgd(0.0)
+                )
+                opt.compression = wire
+                params = {"w": bf.worker_values(lambda r: c[r])}
+                state = opt.init(params)
+                zero = {"w": jnp.zeros((n, dim), jnp.float32)}
+                curve = []
+                for step in range(steps):
+                    params, state = opt.step(params, state, zero)
+                    if step == 0 and seed == 0 and wire not in (
+                        None, "bf16",
+                    ):
+                        # first-step quant error: the EF tiers drive
+                        # theirs to exactly 0 at consensus, so the
+                        # meaningful sample is the full-magnitude one
+                        bf_metrics.flush()
+                        g = bf_metrics.snapshot().get(
+                            "bluefog.gossip.quant_err"
+                        )
+                        quant_err = g["value"] if g else None
+                    if step % curve_every == 0 or step == steps - 1:
+                        curve.append(
+                            round(consensus_dist(
+                                np.asarray(params["w"])
+                            ), 8)
+                        )
+                curves.append(curve)
+            finals[name] = [cv[-1] for cv in curves]
+            summary = scaling.plan_comm_summary(
+                plan, dim * 4, wire=wire
+            )
+            line = {
+                "metric": "quant_tier",
+                "wire": name,
+                "n_workers": n,
+                "dim": dim,
+                "steps": steps,
+                "rounds": summary["rounds"],
+                "wire_bytes_per_step": plan.wire_bytes(dim, 4, wire=wire),
+                "effective_compression_ratio": summary[
+                    "effective_compression_ratio"
+                ],
+                "final_consensus_median": float(
+                    np.median(finals[name])
+                ),
+                "final_consensus_seeds": finals[name],
+                "consensus_curve": curves[0],
+            }
+            if quant_err is not None:
+                line["quant_err_rms"] = round(float(quant_err), 8)
+            print(json.dumps(line), flush=True)
+        bf.shutdown()
+
+        # the disclosed A/A floor: the reference tier's own multi-seed
+        # spread of final consensus distance (different random problems,
+        # same config) — the resolution limit of "equal quality"
+        int8_f = np.asarray(finals["int8"], np.float64)
+        aa_noise_pct = float(
+            100.0 * (int8_f.max() - int8_f.min())
+            / max(int8_f.min(), 1e-30)
+        )
+        b_int8 = plan.wire_bytes(dim, 4, wire="int8")
+        b_int4 = plan.wire_bytes(dim, 4, wire="int4")
+        b_int4ef = plan.wire_bytes(dim, 4, wire="int4_ef")
+        ratio = b_int8 / b_int4
+        int8_med = float(np.median(finals["int8"]))
+        int4ef_med = float(np.median(finals["int4_ef"]))
+        equal_quality = int4ef_med <= int8_med * (
+            1.0 + aa_noise_pct / 100.0
+        )
+        print(json.dumps({
+            "metric": "quant_summary",
+            "n_workers": n,
+            "dim": dim,
+            "wire_bytes_int8": b_int8,
+            "wire_bytes_int4": b_int4,
+            "wire_bytes_int4_ef": b_int4ef,
+            "wire_reduction_int4_vs_int8": round(ratio, 4),
+            "aa_noise_pct": round(aa_noise_pct, 3),
+            "final_consensus_int8": int8_med,
+            "final_consensus_int4_ef": int4ef_med,
+            "int4_ef_no_worse_than_int8": bool(equal_quality),
+        }), flush=True)
+
+        # push-sum mass conservation under the quantized window wire
+        os.environ["BLUEFOG_WINDOW_WIRE"] = "int4"
+        os.environ["BLUEFOG_METRICS"] = "0"
+        bf.init(devices=jax.devices()[:n])
+        bf.set_topology(topo.ExponentialTwoGraph(n))
+        bf.turn_on_win_ops_with_associated_p()
+        x0 = (
+            np.random.RandomState(0).randn(n, dim).astype(np.float32) * 3
+        )
+        bf.win_create(
+            bf.worker_values(lambda r: x0[r]), "quant_ps", zero_init=True
+        )
+        outs = bf.get_context().out_neighbor_ranks()
+        dst = [
+            {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]}
+            for r in range(n)
+        ]
+        sw = [1.0 / (len(outs[r]) + 1) for r in range(n)]
+        total0 = x0.sum(0, dtype=np.float64)
+        max_drift = 0.0
+        ps_steps = int(os.environ.get("BENCH_QUANT_PS_STEPS", "25"))
+        for _ in range(ps_steps):
+            bf.win_accumulate(
+                name="quant_ps", self_weight=sw, dst_weights=dst
+            )
+            bf.win_update_then_collect("quant_ps")
+            v = np.asarray(bf.win_read("quant_ps"), np.float64)
+            max_drift = max(
+                max_drift, float(np.abs(v.sum(0) - total0).max())
+            )
+        p = win_mod.win_associated_p("quant_ps")
+        est = np.asarray(bf.win_read("quant_ps")) / np.asarray(
+            p
+        )[:, None]
+        # bound: f32 rounding of the running sums, NOT quantization
+        # magnitude — per-element mass error accumulates as ~n_workers *
+        # steps * ulp(sum) with the quantization residual absorbed
+        mass_bound = float(
+            ps_steps * n * float(np.abs(x0).max())
+            * np.finfo(np.float32).eps * 64
+        )
+        mass_ok = max_drift < mass_bound
+        print(json.dumps({
+            "metric": "quant_window_mass",
+            "wire": "int4",
+            "n_workers": n,
+            "dim": dim,
+            "ps_steps": ps_steps,
+            "max_mass_drift": round(max_drift, 9),
+            "mass_bound": round(mass_bound, 9),
+            "mass_conserved": bool(mass_ok),
+            "consensus_err": round(
+                float(np.abs(est - x0.mean(0)).max()), 6
+            ),
+        }), flush=True)
+        bf.shutdown()
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        assert ratio >= 2.0, (
+            f"int4 wire reduction vs int8 is {ratio:.3f}x, below the "
+            "2x acceptance bound"
+        )
+        assert equal_quality, (
+            f"int4_ef final consensus {int4ef_med:.3e} exceeds int8's "
+            f"{int8_med:.3e} beyond the {aa_noise_pct:.2f}% A/A floor"
+        )
+        assert mass_ok, (
+            f"push-sum mass drift {max_drift:.3e} exceeds the f32 "
+            f"rounding bound {mass_bound:.3e} under the int4 window wire"
+        )
+    return 0
+
+
 def run_all() -> int:
     """The full evidence set: each family in an isolated subprocess (the
     scaling family must own backend init; a family crash must not take
@@ -2410,7 +2658,7 @@ def run_all() -> int:
     import subprocess
 
     for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
-                 "flight", "attribution", "gossip", "flash",
+                 "flight", "attribution", "quant", "gossip", "flash",
                  "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
@@ -2453,6 +2701,7 @@ def main() -> int:
         "metrics": run_metrics,
         "flight": run_flight,
         "attribution": run_attribution,
+        "quant": run_quant,
         "gossip": run_gossip_overhead,
         "transformer": run_transformer,
         "flash": run_flash,

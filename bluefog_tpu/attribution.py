@@ -544,9 +544,11 @@ class StepDoctor:
             n = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
             item = np.dtype(leaf.dtype).itemsize
             by_item[item] = by_item.get(item, 0) + n
-        wire_itemsize = col_ops._WIRE_ITEMSIZE.get(wire)
-        if wire_itemsize is not None:
-            by_item = {wire_itemsize: sum(by_item.values())}
+        if wire in col_ops._COMPRESSED_WIRES:
+            # collapse the dtype groups: a compressed wire reprices every
+            # element identically, and wire_bytes_per_step ignores the
+            # storage itemsize for quantized tiers (the key is arbitrary)
+            by_item = {1: sum(by_item.values())}
         return float(metrics_mod.wire_bytes_per_step(by_item, 1, wire))
 
     def _sample(self, ctx, *, step, outputs, plan, params, wire,

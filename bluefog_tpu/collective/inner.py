@@ -342,6 +342,84 @@ def _chunk_quantize(xf):
     return q, s, xhat
 
 
+def _pack_nibbles(q):
+    """Pack ``[n_chunks, 512]`` int4 values (int8 storage, range [-7, 7])
+    into ``[n_chunks, 256]`` int8 lanes: block element ``k`` rides the
+    LOW nibble of lane ``k`` and element ``256 + k`` the HIGH nibble
+    (deinterleaved halves, not even/odd interleave — the interleave's
+    stack+reshape unpack was observed to perturb XLA:CPU's fused-loop
+    partitioning enough to flip 1-ulp rounding between the chunked and
+    monolithic combine lowerings; the halves layout unpacks as a plain
+    two-piece concat and is stable). Exact round-trip with
+    :func:`_unpack_nibbles` for every value in range (the arithmetic
+    right shift sign-extends the nibble back)."""
+    half = q.shape[1] // 2
+    lo = q[:, :half] & jnp.int8(0x0F)
+    hi = jnp.left_shift(q[:, half:], 4)
+    return lo | hi
+
+
+def _unpack_nibbles(p):
+    """Inverse of :func:`_pack_nibbles`: ``[n_chunks, 256]`` int8 ->
+    ``[n_chunks, 512]`` int8 in [-8, 7] (``<< 4 >> 4`` sign-extends the
+    low-nibble half; ``>> 4`` the high half)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _chunk_quantize4(xf):
+    """Chunked int4 (block-scaled) quantization of a flat f32 vector:
+    ``(packed, s16, xhat)`` with ``packed`` ``[n_chunks, 256]`` int8
+    (two nibbles per lane, :func:`_pack_nibbles`), ``s16`` the per-block
+    scale in **bf16** (bf16 shares f32's exponent range, so the
+    zero-guard survives, and the 2-byte sidecar is what lands the exact
+    2x wire reduction vs int8's 4-byte f32 scales), and ``xhat`` the
+    dequantized reconstruction. The quantizer snaps the scale to bf16
+    FIRST and quantizes against the widened bf16 value, so sender and
+    every receiver reconstruct from identical (q, s) bits — the
+    property both the difference-form combine and the CHOCO copies
+    rely on. The ``optimization_barrier`` pins the scale payload dtype
+    (without it XLA commutes the f32 widening across the ppermute and
+    ships f32 scales)."""
+    chunk = _QUANT_CHUNK
+    n = xf.size
+    n_chunks = -(-n // chunk)
+    flat = jnp.pad(xf.ravel(), (0, n_chunks * chunk - n))
+    resh = flat.reshape(n_chunks, chunk)
+    s = jnp.maximum(
+        jnp.max(jnp.abs(resh), axis=1), jnp.finfo(jnp.float32).tiny
+    ) / 7.0
+    s16 = lax.optimization_barrier(s.astype(jnp.bfloat16))
+    sw = s16.astype(jnp.float32)
+    q = jnp.clip(jnp.round(resh / sw[:, None]), -7, 7).astype(jnp.int8)
+    xhat = (q.astype(jnp.float32) * sw[:, None]).reshape(-1)[:n]
+    return _pack_nibbles(q), s16, xhat
+
+
+def _dequant4(packed, s16, n):
+    """Flat [n] f32 reconstruction from the int4 wire pair. Every
+    arithmetic step is EXACT in f32 (the nibble holds <=3 significant
+    bits, the bf16 scale 8 — their product always fits a f32 mantissa),
+    so sender and receivers reconstruct identical bits from identical
+    wire bits, and the reconstruction is insensitive to fusion order."""
+    q = _unpack_nibbles(packed).astype(jnp.float32)
+    full = q * s16.astype(jnp.float32)[:, None]
+    return full.reshape(-1)[:n]
+
+
+def _dequant8(q, s, n):
+    """Flat [n] f32 reconstruction from the int8 wire pair."""
+    return (q.astype(jnp.float32) * s[:, None]).reshape(-1)[:n]
+
+
+def _block_quantizer(wire):
+    """(quantize, dequantize) pair of a block-scaled integer wire."""
+    if wire == "int4":
+        return _chunk_quantize4, _dequant4
+    return _chunk_quantize, _dequant8
+
+
 def weighted_combine_quantized_ef_operands(
     x: jnp.ndarray,
     state: Tuple[jnp.ndarray, jnp.ndarray],
@@ -349,8 +427,9 @@ def weighted_combine_quantized_ef_operands(
     recv_w: jnp.ndarray,
     axis_name: str,
     chunks: int = 1,
+    wire: str = "int8",
 ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Int8 wire with memory (CHOCO-style difference compression).
+    """Quantized wire with memory (CHOCO-style difference compression).
 
     Plain quantized gossip has a noise floor: the transmitted signal (the
     raw iterate) keeps full magnitude, so its quantization step never
@@ -371,6 +450,11 @@ def weighted_combine_quantized_ef_operands(
     ``(y, new_state)``. The caller owns the state (optimizer memory; the
     stateless eager facade exposes only the memoryless wires).
 
+    ``wire`` selects the compressor Q: ``'int8'`` (the original tier) or
+    ``'int4'`` (block-scaled nibble-packed, :func:`_chunk_quantize4` —
+    the ``int4_ef`` tier: half int8's wire bytes, and the EF recursion
+    erases the coarser quantizer's larger noise floor the same way).
+
     ``chunks > 1`` chunks only the TRANSFERS (512-aligned bounds, per-
     chunk ppermutes in wavefront order); quantization, integration and
     the accumulate all run at full width on the concatenated received
@@ -379,6 +463,11 @@ def weighted_combine_quantized_ef_operands(
     (relay) plans are refused upstream: the copies integrate a fixed
     per-round source, which a relay round does not have.
     """
+    if wire not in ("int8", "int4"):
+        raise ValueError(
+            f"error-feedback wire must be 'int8' or 'int4', got {wire!r}"
+        )
+    quantize, dequant = _block_quantizer(wire)
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
@@ -387,16 +476,14 @@ def weighted_combine_quantized_ef_operands(
     n = xf.size
     bounds = chunk_bounds(n, chunks)
     if len(bounds) == 1:
-        q, sc, dhat = _chunk_quantize(xf - xhat_self)
+        q, sc, dhat = quantize(xf - xhat_self)
         xhat_self_new = xhat_self + dhat
         y = xw
         new_recv = []
         for r, perm in enumerate(perms):
             recv_q = lax.ppermute(q, axis_name, perm)
             recv_s = lax.ppermute(sc, axis_name, perm)
-            recv_dhat = (
-                recv_q.astype(jnp.float32) * recv_s[:, None]
-            ).reshape(-1)[:n]
+            recv_dhat = dequant(recv_q, recv_s, n)
             hat_r = xhat_recv[r] + recv_dhat
             new_recv.append(hat_r)
             y = y + (
@@ -415,7 +502,7 @@ def weighted_combine_quantized_ef_operands(
     # per-chunk accumulates can flip XLA:CPU FMA/factoring decisions at
     # some buffer widths and break the bitwise chunked==monolithic pin.
     R, C = len(perms), len(bounds)
-    q, sc, dhat = _chunk_quantize(xf - xhat_self)
+    q, sc, dhat = quantize(xf - xhat_self)
     xhat_self_new = xhat_self + dhat
     groups = _chunk_group_bounds(bounds)
     recv_qs = [[None] * C for _ in range(R)]
@@ -429,9 +516,7 @@ def weighted_combine_quantized_ef_operands(
     for r in range(R):
         recv_q = jnp.concatenate(recv_qs[r])
         recv_s = jnp.concatenate(recv_ss[r])
-        recv_dhat = (
-            recv_q.astype(jnp.float32) * recv_s[:, None]
-        ).reshape(-1)[:n]
+        recv_dhat = dequant(recv_q, recv_s, n)
         hat_r = xhat_recv[r] + recv_dhat
         new_recv.append(hat_r)
         y = y + (
@@ -455,12 +540,15 @@ def weighted_combine_quantized_operands(
     per-step varying weights never recompile).
 
     The gossip transfer is the scaling bottleneck on DCN-attached meshes;
-    quantizing the ppermute payload cuts wire bytes 4x (vs f32) at the
-    cost of bounded rounding error — the XLA-collective analogue of
+    quantizing the ppermute payload cuts wire bytes 4x (``int8``) or 8x
+    (``int4``, two nibbles packed per int8 lane) vs f32, at the cost of
+    bounded rounding error — the XLA-collective analogue of
     quantized-allreduce designs (EQuARX, arXiv:2506.17615). Per-worker
     symmetric scheme: ``q = round(x / s)`` with ``s = max|x| / 127``
-    (int8), scale computed and shipped in f32 (an fp16 input's own tiny
-    range would flush the zero-guard and NaN an all-zero tensor).
+    (int8) or ``max|x| / 7`` (int4), scale computed and shipped in f32
+    (int8; an fp16 input's own tiny range would flush the zero-guard and
+    NaN an all-zero tensor) or bf16 (int4 — same exponent range as f32,
+    and the 2-byte sidecar keeps the full 2x reduction vs int8).
     Scales are per 512-element CHUNK of the flattened payload (~0.2 %
     wire overhead), not one global scale: the optimizer layer fuses the
     whole model into one vector before gossiping, and a single scale
@@ -474,8 +562,10 @@ def weighted_combine_quantized_operands(
     plain dequantize-and-average would keep injecting rounding noise
     forever.
     """
-    if wire not in ("int8", "bf16"):
-        raise ValueError(f"wire must be 'int8' or 'bf16', got {wire!r}")
+    if wire not in ("int8", "bf16", "int4"):
+        raise ValueError(
+            f"wire must be 'int8', 'bf16', or 'int4', got {wire!r}"
+        )
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
@@ -541,14 +631,17 @@ def weighted_combine_quantized_operands(
             ).astype(wdt)
         return y
 
+    # int8 / int4 block-scaled integer wires share one lowering; only
+    # the quantizer pair differs (int4 packs two nibbles per int8 lane
+    # and ships bf16 block scales — see _chunk_quantize4)
+    quantize, deq_flat = _block_quantizer(wire)
     xf = xw.astype(jnp.float32)
     n = xf.size
     if chunks <= 1 and inject is None:
-        q, s, xhat_flat = _chunk_quantize(xf.ravel())
+        q, s, xhat_flat = quantize(xf.ravel())
 
         def dequant(qq, ss):
-            full = (qq.astype(jnp.float32) * ss[:, None]).reshape(-1)[:n]
-            return full.reshape(x.shape).astype(wdt)
+            return deq_flat(qq, ss, n).reshape(x.shape).astype(wdt)
 
         xhat_self = xhat_flat.reshape(x.shape).astype(wdt)
         y = xw
@@ -560,7 +653,7 @@ def weighted_combine_quantized_operands(
             ].astype(wdt)
         return y
 
-    # chunked / relay int8: only the TRANSFERS are chunked — quantize
+    # chunked / relay int8/int4: only the TRANSFERS are chunked — quantize
     # once at full width (bounds snap to the 512-element scale grid, so
     # per-chunk wire slices are whole scale groups), ship per-chunk
     # (q, scales) slices, and concatenate each round's received chunks
@@ -570,7 +663,7 @@ def weighted_combine_quantized_operands(
     # Relay rounds forward the (q, scales) pair verbatim; arithmetic
     # only happens at deliveries.
     bounds = chunk_bounds(n, chunks)
-    q, s, xhat_flat = _chunk_quantize(xf.ravel())
+    q, s, xhat_flat = quantize(xf.ravel())
     xhat_self = xhat_flat.reshape(x.shape).astype(wdt)
     groups = _chunk_group_bounds(bounds)
     qs = [q[ga:gb] for ga, gb in groups]
@@ -598,9 +691,7 @@ def weighted_combine_quantized_operands(
     for r in range(R):
         recv_q = recv_qs[r][0] if C == 1 else jnp.concatenate(recv_qs[r])
         recv_s = recv_ss[r][0] if C == 1 else jnp.concatenate(recv_ss[r])
-        deq = (
-            recv_q.astype(jnp.float32) * recv_s[:, None]
-        ).reshape(-1)[:n].reshape(x.shape).astype(wdt)
+        deq = deq_flat(recv_q, recv_s, n).reshape(x.shape).astype(wdt)
         y = y + (deq - xhat_self) * recv_w[r, idx].astype(wdt)
     return y
 
@@ -654,7 +745,8 @@ def neighbor_allreduce_step(
 
 
 def neighbor_allgather(
-    x: jnp.ndarray, plan: CommPlan, axis_name: str
+    x: jnp.ndarray, plan: CommPlan, axis_name: str,
+    wire: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Collect raw (unweighted) in-neighbor values.
 
@@ -666,10 +758,74 @@ def neighbor_allgather(
     ``[max_in_degree]``; rows are the in-neighbors ascending, zero-padded
     for ranks with fewer in-neighbors. The eager facade slices the padding
     off per rank.
+
+    ``wire`` compresses the gather payload — ``'bf16'`` (2x fewer bytes),
+    ``'int8'`` / ``'int4'`` (4x / 8x, block-scaled, same quantizers as
+    the combine wires). Unlike the combine there is no difference form
+    to hide the rounding: receivers get ``dequant(Q(x))``, a bounded
+    approximation of each neighbor's value (error <= one quantization
+    step per 512-block), cast back to ``x.dtype``. Relay (short-cut)
+    plans forward the compressed pair verbatim, so compression composes
+    with every route family. Float payloads only — integer inputs would
+    silently round-trip through the float wire.
     """
+    if wire not in (None, "bf16", "int8", "int4"):
+        raise ValueError(
+            "neighbor_allgather wire must be None, 'bf16', 'int8', or "
+            f"'int4', got {wire!r}"
+        )
+    if wire is not None and not jnp.issubdtype(x.dtype, jnp.inexact):
+        raise ValueError(
+            f"quantized neighbor_allgather needs a float payload, got "
+            f"{x.dtype}"
+        )
     idx = lax.axis_index(axis_name)
     inject = _plan_inject(plan)
-    if inject is None:
+    if wire == "bf16":
+        # dtype-pinned like the combine's bf16 wire: the barrier stops
+        # XLA from commuting the widening convert across the ppermute
+        q16 = lax.optimization_barrier(x.astype(jnp.bfloat16))
+        if inject is None:
+            received = [
+                lax.ppermute(q16, axis_name, rnd.perm).astype(x.dtype)
+                for rnd in plan.rounds
+            ]
+        else:
+            flags = _inject_flags(inject, idx)
+            received = []
+            transit = jnp.zeros_like(q16)
+            for r, rnd in enumerate(plan.rounds):
+                send = jnp.where(flags[r], q16, transit)
+                recv = lax.ppermute(send, axis_name, rnd.perm)
+                transit = recv
+                received.append(recv.astype(x.dtype))
+    elif wire in ("int8", "int4"):
+        quantize, deq_flat = _block_quantizer(wire)
+        n = x.size
+        q, s, _xhat = quantize(x.astype(jnp.float32).ravel())
+
+        def deq(qq, ss):
+            return deq_flat(qq, ss, n).reshape(x.shape).astype(x.dtype)
+
+        received = []
+        if inject is None:
+            for rnd in plan.rounds:
+                recv_q = lax.ppermute(q, axis_name, rnd.perm)
+                recv_s = lax.ppermute(s, axis_name, rnd.perm)
+                received.append(deq(recv_q, recv_s))
+        else:
+            # relay rounds forward the (q, scales) pair verbatim;
+            # dequantization happens only at the receive side of each
+            # round — delivery rounds' transit holds the source's bits
+            flags = _inject_flags(inject, idx)
+            tq, ts = jnp.zeros_like(q), jnp.zeros_like(s)
+            for r, rnd in enumerate(plan.rounds):
+                send_q = jnp.where(flags[r], q, tq)
+                send_s = jnp.where(flags[r], s, ts)
+                tq = lax.ppermute(send_q, axis_name, rnd.perm)
+                ts = lax.ppermute(send_s, axis_name, rnd.perm)
+                received.append(deq(tq, ts))
+    elif inject is None:
         received = [
             lax.ppermute(x, axis_name, rnd.perm) for rnd in plan.rounds
         ]
@@ -746,7 +902,8 @@ def hierarchical_neighbor_allreduce_quantized(
     wire: str = "int8",
 ) -> jnp.ndarray:
     """Hierarchical combine with the machine-level (DCN) leg quantized
-    (``wire='int8'`` quarters its bytes, ``'bf16'`` halves them):
+    (``wire='int8'`` quarters its bytes, ``'bf16'`` halves them,
+    ``'int4'`` cuts them 8x):
     intra-host ``psum`` stays exact on ICI; the cross-host gossip — the
     transfer that scales with pod count — is the compressed leg (see
     :func:`weighted_combine_quantized_operands`)."""

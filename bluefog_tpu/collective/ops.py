@@ -274,23 +274,31 @@ def _plan_method() -> str:
     return os.environ.get("BLUEFOG_PLAN_METHOD", "auto")
 
 
-_WIRE_ITEMSIZE = {"int8": 1, "int8_ef": 1, "bf16": 2}
+# Every compressed wire tier name. Membership test only — the bytes any
+# tier actually ships (scale sidecar included) come from the single
+# canonical accounting, scaling.wire_payload_bytes.
+_COMPRESSED_WIRES = frozenset(
+    {"int8", "int8_ef", "bf16", "int4", "int4_ef"}
+)
 
 
 def _plan_chunks(plan: CommPlan, x, compression=None) -> int:
     """Per-dispatch chunk count for the eager combine: the compiler's
     Pareto chooser over this call's actual per-worker WIRE payload (x is
     a worker array; row 0's elements are what one rank ships per round,
-    at the compressed wire width when a quantized wire is active — the
-    latency/bandwidth crossover moves with the bytes on the wire, not
-    the uncompressed input). ``BLUEFOG_PLAN_CHUNKS`` overrides; forced
-    (non-auto) plan methods pin 1 so A/B runs isolate one axis (see
-    compiler.choose_chunks)."""
+    at the compressed wire width — scale sidecar included — when a
+    quantized wire is active; the latency/bandwidth crossover moves
+    with the bytes on the wire, not the uncompressed input).
+    ``BLUEFOG_PLAN_CHUNKS`` overrides; forced (non-auto) plan methods
+    pin 1 so A/B runs isolate one axis (see compiler.choose_chunks)."""
+    from bluefog_tpu import scaling
+
     n_elems = 1
     for d in x.shape[1:]:
         n_elems *= int(d)
-    itemsize = _WIRE_ITEMSIZE.get(compression, jnp.dtype(x.dtype).itemsize)
-    payload = n_elems * itemsize
+    payload = scaling.wire_payload_bytes(
+        n_elems, jnp.dtype(x.dtype).itemsize, compression
+    )
     compiled = plan.compile_info
     return compiler.choose_chunks(
         compiled if compiled is not None else len(plan.rounds),
@@ -464,9 +472,9 @@ def _combine_for(compression, chunks: int = 1):
     (shared by the eager facade and the torch frontend, so the validation
     and wire selection cannot drift apart). ``chunks`` is the pipelined
     chunk count the plan chooser picked for this payload."""
-    if compression not in (None, "int8", "bf16"):
+    if compression not in (None, "int8", "bf16", "int4"):
         raise ValueError(
-            "compression must be None, 'int8', or 'bf16', got "
+            "compression must be None, 'int8', 'bf16', or 'int4', got "
             f"{compression!r}"
         )
     if compression is None:
@@ -523,8 +531,8 @@ def neighbor_allreduce(
     ``mpi_ops.cc:99-164``; exchange ``mpi_controller.cc:419-551``.
 
     ``compression='int8'`` quantizes the wire payload (4x fewer gossip
-    bytes, bounded rounding error) and ``'bf16'`` halves it
-    near-losslessly (see
+    bytes, bounded rounding error), ``'int4'`` packs two block-scaled
+    nibbles per byte (8x), and ``'bf16'`` halves it near-losslessly (see
     :func:`bluefog_tpu.collective.inner.weighted_combine_quantized`) —
     capabilities the reference does not have.
     """
@@ -541,18 +549,50 @@ def neighbor_allreduce(
     )
 
 
-def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
+def neighbor_allgather_nonblocking(
+    x, name: Optional[str] = None, *, compression: Optional[str] = None,
+) -> int:
     ctx = ctx_mod.get_context()
     x = _check_worker_array(ctx, x)
+    if compression is not None:
+        # validate BEFORE any telemetry: a rejected dispatch must not
+        # inflate the wire-byte counter (inner.neighbor_allgather
+        # re-checks both at trace time for direct callers)
+        if compression not in ("bf16", "int8", "int4"):
+            raise ValueError(
+                "neighbor_allgather compression must be None, 'bf16', "
+                f"'int8', or 'int4', got {compression!r}"
+            )
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            raise ValueError(
+                f"quantized neighbor_allgather needs a float payload, "
+                f"got {x.dtype}"
+            )
     plan = _static_plan(ctx)
     fn = _compiled(
-        ctx, "neighbor_allgather", (plan,) + _aval_key(x),
-        lambda xb: inner.neighbor_allgather(xb, plan, ctx_mod.WORKER_AXIS),
+        ctx, "neighbor_allgather", (plan, compression) + _aval_key(x),
+        lambda xb: inner.neighbor_allgather(
+            xb, plan, ctx_mod.WORKER_AXIS, wire=compression
+        ),
         in_specs=P(ctx_mod.WORKER_AXIS),
         out_specs=(P(ctx_mod.WORKER_AXIS), P(ctx_mod.WORKER_AXIS)),
     )
     size, max_deg = ctx.size, plan.max_in_degree
     in_neighbors = plan.in_neighbors
+    if compression is not None and metrics.enabled():
+        # allgather wire telemetry: quantization error replayed host-side
+        # on a 512-aligned input prefix (the input is already on the
+        # host side of this eager call) + wire-byte accounting with the
+        # scale sidecar priced in
+        n_elems = 1
+        for d in x.shape[1:]:
+            n_elems *= int(d)
+        metrics.record_allgather_wire(
+            x, compression,
+            plan.wire_bytes(
+                n_elems, jnp.dtype(x.dtype).itemsize, wire=compression
+            ),
+        )
 
     def post(result):
         vals, _mask = result
@@ -568,15 +608,26 @@ def neighbor_allgather_nonblocking(x, name: Optional[str] = None) -> int:
     return _new_handle(fn(x), post)
 
 
-def neighbor_allgather(x, name: Optional[str] = None) -> List[jax.Array]:
+def neighbor_allgather(
+    x, name: Optional[str] = None, *, compression: Optional[str] = None,
+) -> List[jax.Array]:
     """Collect raw in-neighbor values, rank-ascending.
 
     Returns a per-rank list: entry ``r`` has shape ``[in_degree_r, ...]``
     (the reference concatenates along dim 0, mpi_ops.py:264-323; we keep
     the neighbor axis explicit — ``.reshape(-1, *rest)`` recovers the
     reference layout).
+
+    ``compression='bf16'|'int8'|'int4'`` quantizes the gather wire (2x /
+    4x / 8x fewer bytes). There is no difference form on this surface —
+    the op returns raw values, so receivers see ``dequant(Q(x))``, a
+    bounded approximation (error <= one quantization step per
+    512-element block; see
+    :func:`bluefog_tpu.collective.inner.neighbor_allgather`).
     """
-    return synchronize(neighbor_allgather_nonblocking(x, name))
+    return synchronize(
+        neighbor_allgather_nonblocking(x, name, compression=compression)
+    )
 
 
 def hierarchical_neighbor_allreduce_nonblocking(

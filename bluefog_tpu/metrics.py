@@ -92,6 +92,7 @@ __all__ = [
     "fold_device_payload",
     "drain_device_buffer",
     "wire_bytes_per_step",
+    "record_allgather_wire",
 ]
 
 
@@ -215,8 +216,10 @@ def peek(name: str):
 
 def reset() -> None:
     """Drop every registered series (test isolation)."""
+    global _allgather_calls
     with _lock:
         _registry.clear()
+        _allgather_calls = 0
 
 
 # -- env knobs ----------------------------------------------------------------
@@ -342,14 +345,14 @@ def build_probe_payload(pairs, g_subs, wire=None):
         "pack": (),
         "ef": (),
     }
-    if wire in ("int8", "bf16", "int8_ef"):
+    if wire in _QUANT_WIRES:
         # unscaled slice + its ratio: the host quantizes the slice
         # itself, so the scale cannot be folded into the values
         payload["pack"] = tuple(
             (sx.astype(jnp.float32), jnp.full((1,), sc, jnp.float32))
             for sx, _sy, sc, _e in pairs
         )
-    if wire == "int8_ef":
+    if wire in _EF_WIRES:
         payload["ef"] = tuple(e for _sx, _sy, _sc, e in pairs)
     return payload
 
@@ -370,6 +373,56 @@ def _np_chunk_quantize(xf):
     q = np.clip(np.round(resh / s[:, None]), -127, 127).astype(np.int8)
     xhat = (q.astype(np.float32) * s[:, None]).reshape(-1)[:n]
     return xhat
+
+
+def _np_pack_nibbles(q):
+    """Host replica of ``inner._pack_nibbles``: [n_chunks, 512] int4
+    values in int8 storage -> [n_chunks, 256] packed int8 (deinterleaved
+    halves layout: element k in the low nibble of lane k, element
+    half+k in the high nibble)."""
+    import numpy as np
+
+    half = q.shape[1] // 2
+    lo = q[:, :half] & np.int8(0x0F)
+    hi = np.left_shift(q[:, half:], 4).astype(np.int8)
+    return lo | hi
+
+
+def _np_unpack_nibbles(p):
+    """Host replica of ``inner._unpack_nibbles`` (arithmetic shifts
+    sign-extend the nibbles back)."""
+    import numpy as np
+
+    lo = np.right_shift(np.left_shift(p, 4).astype(np.int8), 4)
+    hi = np.right_shift(p, 4)
+    return np.concatenate([lo, hi], axis=1)
+
+
+def _np_chunk_quantize4(xf):
+    """Host-side replica of ``inner._chunk_quantize4`` — int4 nibbles
+    against the bf16-snapped block scale, through the pack/unpack pair
+    so the replay exercises the exact wire format."""
+    import ml_dtypes
+    import numpy as np
+
+    n = xf.size
+    n_chunks = -(-n // _ROW)
+    flat = np.pad(xf.astype(np.float32), (0, n_chunks * _ROW - n))
+    resh = flat.reshape(n_chunks, _ROW)
+    s = np.maximum(
+        np.max(np.abs(resh), axis=1), np.finfo(np.float32).tiny
+    ) / 7.0
+    sw = s.astype(ml_dtypes.bfloat16).astype(np.float32)
+    q = np.clip(np.round(resh / sw[:, None]), -7, 7).astype(np.int8)
+    q = _np_unpack_nibbles(_np_pack_nibbles(q))
+    xhat = (q.astype(np.float32) * sw[:, None]).reshape(-1)[:n]
+    return xhat
+
+
+# Every wire tier with a quant-error replay; the _ef members additionally
+# publish the CHOCO residual slot.
+_QUANT_WIRES = ("int8", "bf16", "int8_ef", "int4", "int4_ef")
+_EF_WIRES = ("int8_ef", "int4_ef")
 
 
 def fold_device_payload(payload, wire=None,
@@ -395,7 +448,7 @@ def fold_device_payload(payload, wire=None,
         buf[:, SLOT_PARAM_NORM] += (x ** 2).reshape(size, -1).sum(1)
     for g in gs:
         buf[:, SLOT_GRAD_NORM] += (g ** 2).reshape(size, -1).sum(1)
-    if wire in ("int8", "bf16", "int8_ef"):
+    if wire in _QUANT_WIRES:
         import ml_dtypes
 
         for pi, (sub, scale) in enumerate(payload["pack"]):
@@ -408,13 +461,15 @@ def fold_device_payload(payload, wire=None,
                             .astype(np.float32)) ** 2).sum()
                 elif wire == "int8":
                     err = ((v - _np_chunk_quantize(v)) ** 2).sum()
-                else:  # int8_ef: residual vs the hat-self copy
+                elif wire == "int4":
+                    err = ((v - _np_chunk_quantize4(v)) ** 2).sum()
+                else:  # int8_ef / int4_ef: residual vs the hat-self copy
                     hat = np.asarray(
                         payload["ef"][pi], np.float32
                     )[w].reshape(-1)
                     err = ((v - hat) ** 2).sum()
                 buf[w, SLOT_QUANT_ERR] += err * scale
-        if wire == "int8_ef":
+        if wire in _EF_WIRES:
             buf[:, SLOT_EF_RESIDUAL] = buf[:, SLOT_QUANT_ERR]
     return drain_device_buffer(
         buf, prefix=prefix, export=export, wire=wire
@@ -441,11 +496,9 @@ def drain_device_buffer(buf, prefix: str = "bluefog.gossip",
     out = {"steps": float(counts.max(initial=0.0))}
     denom = np.maximum(counts, 1.0)
     for slot, name in sorted(_SLOT_NAMES.items()):
-        if slot == SLOT_QUANT_ERR and wire not in (
-            "int8", "bf16", "int8_ef",
-        ):
+        if slot == SLOT_QUANT_ERR and wire not in _QUANT_WIRES:
             continue
-        if slot == SLOT_EF_RESIDUAL and wire != "int8_ef":
+        if slot == SLOT_EF_RESIDUAL and wire not in _EF_WIRES:
             continue
         rms = np.sqrt(buf[:, slot] / denom)
         mean_v, max_v = float(rms.mean()), float(rms.max())
@@ -491,27 +544,68 @@ def flush() -> None:
 
 def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
                         wire: Optional[str] = None) -> int:
-    """Per-worker wire bytes one gossip step puts on the interconnect.
+    """Per-worker wire bytes one gossip step puts on the interconnect —
+    delegates to the canonical scale-sidecar-inclusive accounting in
+    :func:`bluefog_tpu.scaling.wire_bytes_per_step` (kept here as a
+    re-export: the optimizer/window counters and ``CommPlan.wire_bytes``
+    call through this name)."""
+    from bluefog_tpu import scaling
 
-    ``n_elems_by_itemsize`` maps payload dtype itemsize -> element count
-    (the per-dtype-group packing of the optimizer layer). Quantized wires
-    replace the payload dtype: int8 ships 1 byte/element plus one f32
-    scale per 512-element chunk (``int8_ef`` identically — the
-    difference payload has the same wire format); bf16 ships 2
-    bytes/element. Every round re-ships the payload, so the total scales
-    with the plan's round count — the per-edge traffic accounting
-    TopoOpt-style co-optimization presumes."""
-    from bluefog_tpu.collective.inner import _QUANT_CHUNK
+    return scaling.wire_bytes_per_step(n_elems_by_itemsize, n_rounds, wire)
 
-    per_round = 0
-    for itemsize, n in n_elems_by_itemsize.items():
-        if wire in ("int8", "int8_ef"):
-            per_round += n + 4 * (-(-n // _QUANT_CHUNK))
-        elif wire == "bf16":
-            per_round += 2 * n
-        else:
-            per_round += itemsize * n
-    return per_round * n_rounds
+
+# Compressed-allgather dispatch count, for the 1-in-metrics_interval
+# quant-error sampling below (the eager gather has no optimizer comm
+# clock to ride).
+_allgather_calls = 0
+
+
+def record_allgather_wire(x, wire: str, wire_bytes: int) -> None:
+    """Quant-error + wire-byte telemetry for one compressed
+    ``neighbor_allgather`` dispatch.
+
+    Wire bytes are counted on every dispatch (a dict update). The
+    quant-error replay follows the gossip tier's sampling discipline —
+    1-in-:func:`metrics_interval` dispatches — and transfers only a
+    512-aligned PREFIX of the input (sliced on device before the host
+    copy, :func:`sample_elems_cap` elements per worker), replayed with
+    the same quantizer replicas the drain-time fold uses: the
+    reconstruction is the restriction of what the wire ships. Publishes
+    ``bluefog.allgather.quant_err[.max]`` (per-worker RMS over the
+    covered prefix)."""
+    import ml_dtypes
+    import numpy as np
+
+    global _allgather_calls
+    counter("bluefog.allgather.wire_bytes").inc(wire_bytes)
+    with _lock:  # check-and-increment atomically, like the registry
+        sampled = _allgather_calls % metrics_interval() == 0
+        _allgather_calls += 1
+    if not sampled:
+        return
+    size = int(x.shape[0])
+    n = 1
+    for d in x.shape[1:]:
+        n *= int(d)
+    cap = sample_elems_cap()
+    keep = min(n, max(_ROW, cap - cap % _ROW))
+    # slice BEFORE the host copy: only O(cap) elements per worker cross
+    # the device boundary, however large the gather payload
+    sub = np.asarray(
+        x.reshape(size, -1)[:, :keep], np.float32
+    )
+    errs = np.zeros(size)
+    for w in range(size):
+        v = sub[w]
+        if wire == "bf16":
+            hat = v.astype(ml_dtypes.bfloat16).astype(np.float32)
+        elif wire == "int8":
+            hat = _np_chunk_quantize(v)
+        else:  # int4
+            hat = _np_chunk_quantize4(v)
+        errs[w] = np.sqrt(((v - hat) ** 2).sum() / max(keep, 1))
+    gauge("bluefog.allgather.quant_err").set(float(errs.mean()))
+    gauge("bluefog.allgather.quant_err.max").set(float(errs.max()))
 
 
 # -- exporters ----------------------------------------------------------------

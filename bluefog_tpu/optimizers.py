@@ -407,8 +407,11 @@ class _GossipOptimizer:
         self.src_weights = None
         self.dst_weights = None
         self.enable_topo_check = True
-        # 'int8' quantizes the gossip wire payload (4x fewer bytes; see
-        # inner.weighted_combine_quantized). Static-plan path only.
+        # Quantized gossip wire: 'bf16' (2x fewer bytes), 'int8' (4x),
+        # 'int4' (8x, block-scaled nibbles), or the error-feedback tiers
+        # 'int8_ef'/'int4_ef' (CHOCO memory removes the quantization
+        # noise floor; see inner.weighted_combine_quantized*).
+        # Static-plan path only.
         self.compression = None
         self.schedule: Optional[SchedulePlan] = None
         # Hierarchical knobs (reference mpi_ops.py:648-821).
@@ -496,13 +499,17 @@ class _GossipOptimizer:
         dispatch; 1 when no payload is known (keying callers that never
         dispatch, e.g. structural tests). A quantized wire ships fewer
         bytes per element than the bucket's storage dtype — the chooser
-        prices the wire payload, not the uncompressed input."""
+        prices the wire payload (scale sidecar included), not the
+        uncompressed input."""
+        from bluefog_tpu import scaling
+
         if payload is None:
             return 1
         payload_bytes, n_elems = payload
-        wire_itemsize = col_ops._WIRE_ITEMSIZE.get(self.compression)
-        if wire_itemsize is not None:
-            payload_bytes = n_elems * wire_itemsize
+        if self.compression is not None:
+            payload_bytes = scaling.wire_payload_bytes(
+                n_elems, payload_bytes // max(n_elems, 1), self.compression
+            )
         compiled = plan.compile_info
         return compiler.choose_chunks(
             compiled if compiled is not None else len(plan.rounds),
@@ -592,22 +599,25 @@ class _GossipOptimizer:
                 # per-step varying weights reuse one compiled program,
                 # same guarantee as the exact path
                 wire = self.compression
-                if wire == "int8_ef":
+                if wire in ("int8_ef", "int4_ef"):
                     if inject is not None:
                         raise ValueError(
-                            "compression='int8_ef' cannot ride a "
+                            f"compression={wire!r} cannot ride a "
                             "short-cut (relay) plan: the CHOCO copies "
                             "integrate a fixed per-round source, which "
                             "relay rounds do not have. Unset "
-                            "BLUEFOG_PLAN_METHOD=shortcut or use "
-                            "compression in (None, 'int8', 'bf16')."
+                            "BLUEFOG_PLAN_METHOD=shortcut or use a "
+                            "memoryless wire (None/'int8'/'bf16'/"
+                            "'int4')."
                         )
+                    ef_wire = "int4" if wire == "int4_ef" else "int8"
                     return (
-                        ("na_q_ef", perms, chunks),
+                        ("na_q_ef", ef_wire, perms, chunks),
                         lambda flat, e, wops: (
                             inner.weighted_combine_quantized_ef_operands(
                                 flat, e, perms, wops[0],
                                 ctx_mod.WORKER_AXIS, chunks=chunks,
+                                wire=ef_wire,
                             )
                         ),
                         (jnp.asarray(recv_w),),
@@ -665,7 +675,7 @@ class _GossipOptimizer:
                 return sw[step % sched.period, idx]
 
             return from_schedule
-        if self.compression in ("int8", "bf16"):
+        if self.compression in ("int8", "bf16", "int4"):
             # quantized path carries only recv_w (wops[0], [rounds, size]);
             # the plan is validated normalized, so s = 1 - sum_r recv_w
             def from_recv(step, wops):
@@ -687,18 +697,20 @@ class _GossipOptimizer:
         if self.compression is None:
             return
         comm = self.communication_type
-        if self.compression not in ("int8", "bf16", "int8_ef"):
+        if self.compression not in (
+            "int8", "bf16", "int8_ef", "int4", "int4_ef",
+        ):
             raise ValueError(
-                "compression must be None, 'int8', 'bf16', or "
-                f"'int8_ef', got {self.compression!r}"
+                "compression must be None, 'int8', 'bf16', 'int4', "
+                f"'int8_ef', or 'int4_ef', got {self.compression!r}"
             )
-        if self.compression == "int8_ef" and (
+        if self.compression in ("int8_ef", "int4_ef") and (
             comm != CommunicationType.neighbor_allreduce
             or self.schedule is not None
         ):
             raise ValueError(
-                "compression='int8_ef' (error feedback carries "
-                "per-worker state) is only supported on the "
+                f"compression={self.compression!r} (error feedback "
+                "carries per-worker state) is only supported on the "
                 "static-plan neighbor_allreduce path"
             )
         if comm not in (
@@ -805,10 +817,12 @@ class _GossipOptimizer:
     def _ensure_ef_state(self, ctx, params, spec, perms):
         """Per-dtype-group CHOCO copies (x_hat_self, x_hat_recv),
         worker-stacked f32; rebuilt (zeroed) whenever the parameter avals
-        OR the communication structure change — x_hat_recv[r] integrates
-        round-r's fixed source, so a new edge set invalidates every copy
-        (stale copies would break the bit-identical-replica invariant;
-        zeroed copies merely re-transmit full magnitude a few rounds)."""
+        OR the communication structure OR the EF wire tier change —
+        x_hat_recv[r] integrates round-r's fixed source, so a new edge
+        set invalidates every copy (stale copies would break the
+        bit-identical-replica invariant; zeroed copies merely
+        re-transmit full magnitude a few rounds), and copies integrated
+        under one quantizer must not seed the other tier's recursion."""
         from jax.sharding import NamedSharding
 
         leaves = jax.tree_util.tree_leaves(params)
@@ -818,6 +832,7 @@ class _GossipOptimizer:
                 for dt, idxs in _dtype_groups(leaves)
             ),
             perms,
+            self.compression,
         )
         if getattr(self, "_ef_sig", None) == sig:
             return
@@ -879,9 +894,11 @@ class _GossipOptimizer:
             gossip_key, gossip_fn, wops = self._gossip_key_and_fn(
                 ctx, self._wire_payload(params)
             )
-        ef = comm_now and not hier and self.compression == "int8_ef"
+        ef = comm_now and not hier and self.compression in (
+            "int8_ef", "int4_ef",
+        )
         if ef:
-            self._ensure_ef_state(ctx, params, spec, gossip_key[1])
+            self._ensure_ef_state(ctx, params, spec, gossip_key[2])
         return (
             hier, mesh, spec, gossip_key, gossip_fn, wops, ef,
             inner.bucket_bytes_cap(),
@@ -897,7 +914,9 @@ class _GossipOptimizer:
         are the ones with a well-defined per-worker payload here."""
         if not comm_now or hier or self.schedule is not None:
             return None
-        if self.compression in ("int8", "bf16", "int8_ef"):
+        if self.compression in (
+            "int8", "bf16", "int8_ef", "int4", "int4_ef",
+        ):
             return self.compression
         return None
 
@@ -949,14 +968,17 @@ class _GossipOptimizer:
             rounds = 0
             # gossip_key layouts: ("na", perms, chunks, inject),
             # ("na_q", wire, perms, chunks, inject),
-            # ("na_q_ef", perms, chunks), ("hier", perms),
+            # ("na_q_ef", wire, perms, chunks), ("hier", perms),
             # ("hier_q", wire, perms) — perms sits at [1] except the
             # wire-tagged quantized keys where it sits at [2]
-            if tag in ("na", "na_q_ef", "hier"):
+            if tag in ("na", "hier"):
                 rounds = len(gossip_key[1])
-                wire = "int8_ef" if tag == "na_q_ef" else None
-            elif tag in ("na_q", "hier_q"):
+            elif tag in ("na_q", "na_q_ef", "hier_q"):
                 wire = gossip_key[1]
+                if tag == "na_q_ef":
+                    # the key carries the inner quantizer name; the
+                    # accounting tier is the _ef wire (same bytes)
+                    wire = f"{wire}_ef"
                 rounds = len(gossip_key[2])
             elif isinstance(tag, SchedulePlan):
                 rounds = max(len(p.rounds) for p in tag.plans)
@@ -1183,12 +1205,13 @@ class _GossipOptimizer:
 
         def train_step(params, opt_state, *batch):
             ctx = ctx_mod.get_context()
-            if delayed and self.compression == "int8_ef":
+            if delayed and self.compression in ("int8_ef", "int4_ef"):
                 raise ValueError(
-                    "compression='int8_ef' cannot carry error feedback "
-                    "across a one-step delay (the CHOCO copies would "
-                    "integrate a stale payload and desynchronize); use "
-                    "delayed=False or compression in (None,'int8','bf16')"
+                    f"compression={self.compression!r} cannot carry "
+                    "error feedback across a one-step delay (the CHOCO "
+                    "copies would integrate a stale payload and "
+                    "desynchronize); use delayed=False or a memoryless "
+                    "wire (None/'int8'/'bf16'/'int4')"
                 )
             comm_now = self._comm_now()
             (
@@ -1854,10 +1877,11 @@ class _WindowOptimizer:
         perms, slot_table = win_mod._lowered_exchange(ctx, win, w_edges)
         up_self, up_w, up_part, reset = self._update_config(ctx, win)
         slot_w = win_mod._slot_weights(win, up_w, ctx.size)
+        wire = win_mod.window_wire()
 
         key = (
             "wopt_fused_step", self._uid, self._tx_version, ex_mode, perms,
-            tuple(map(tuple, slot_table)), reset, update_p,
+            tuple(map(tuple, slot_table)), reset, update_p, wire,
         ) + _aval_key((opt_state, grads))
         fn = ctx.op_cache.get(key)
         if fn is None:
@@ -1870,7 +1894,10 @@ class _WindowOptimizer:
             win_shape = win.shape
 
             def body(value, buffers, versions, p, p_buffers, s_b, g_b, wops):
-                ex_recv_w, ex_self_w, up_self_w, up_slot_w, up_part_arr = wops
+                (
+                    ex_recv_w, ex_self_w, ex_sent_w,
+                    up_self_w, up_slot_w, up_part_arr,
+                ) = wops
                 v, bufs, vers = value[0], buffers[0], versions[0]
                 pv, pbufs = p[0], p_buffers[0]
                 s = _tree_block(s_b)
@@ -1892,6 +1919,7 @@ class _WindowOptimizer:
                     axis, ex_mode, perms, slots_const, update_p,
                     max_deg, win_shape,
                     xb, bufs, vers, pv, pbufs, xb, ex_recv_w, ex_self_w,
+                    wire=wire, sent_w=ex_sent_w,
                 )
                 v, bufs, vers, pv, pbufs = win_mod._update_core(
                     axis, reset, update_p, max_deg,
@@ -1921,6 +1949,7 @@ class _WindowOptimizer:
         wops = (
             jnp.asarray(win_mod._round_weights(perms, w_edges)),
             jnp.asarray(np.asarray(ex_self, np.float64)),
+            jnp.asarray(np.asarray(w_edges.sum(axis=1), np.float64)),
             jnp.asarray(np.asarray(up_self, np.float64)),
             jnp.asarray(np.asarray(slot_w, np.float64)),
             jnp.asarray(up_part, bool),

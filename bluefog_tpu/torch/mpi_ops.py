@@ -203,7 +203,8 @@ def neighbor_allreduce(
 ) -> torch.Tensor:
     """Weighted neighbor combine per the active (or explicit) topology;
     differentiable (adjoint = transposed-weight combine, always full
-    precision). ``compression='int8'|'bf16'`` quantizes the forward wire
+    precision). ``compression='int8'|'bf16'|'int4'`` quantizes the
+    forward wire
     (see :func:`bluefog_tpu.collective.ops.neighbor_allreduce`)."""
     return _NeighborAllreduce.apply(
         t, self_weight, src_weights, dst_weights, enable_topo_check,

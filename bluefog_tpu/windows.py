@@ -39,6 +39,7 @@ the op this call.
 """
 
 import contextlib
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -50,6 +51,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
 from bluefog_tpu import metrics as metrics_mod
+from bluefog_tpu.collective import inner
 from bluefog_tpu.collective import ops as col_ops
 from bluefog_tpu.topology.graphs import GetRecvWeights
 
@@ -73,6 +75,7 @@ __all__ = [
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
     "win_associated_p",
+    "window_wire",
 ]
 
 
@@ -314,11 +317,36 @@ def _slot_table(win: _Window, perms) -> np.ndarray:
     return table
 
 
+# -- the quantized window wire ------------------------------------------------
+
+
+_WINDOW_WIRES = ("bf16", "int8", "int4")
+
+
+def window_wire() -> Optional[str]:
+    """The window-op wire tier from ``BLUEFOG_WINDOW_WIRE``: ``None``
+    (fp-exact, the default), ``'bf16'``, ``'int8'``, or ``'int4'``.
+    Quantizes the ppermute payload of every ``win_put`` /
+    ``win_accumulate`` / ``win_get`` (and the fused window-optimizer
+    exchange) — the p lane always stays exact, it is one scalar per
+    rank. See docs/windows.md for the semantics caveats."""
+    w = os.environ.get("BLUEFOG_WINDOW_WIRE", "").strip().lower()
+    if w in ("", "0", "off", "none", "fp32", "f32", "exact"):
+        return None
+    if w not in _WINDOW_WIRES:
+        raise ValueError(
+            f"BLUEFOG_WINDOW_WIRE must be one of {_WINDOW_WIRES} (or "
+            f"unset for the exact wire), got {w!r}"
+        )
+    return w
+
+
 # -- the compiled exchange body ----------------------------------------------
 
 
 def _exchange_core(axis, mode, perms, slots_const, update_p, max_deg, shape,
-                   v, bufs, vers, pv, pbufs, xb, recv_w, self_w):
+                   v, bufs, vers, pv, pbufs, xb, recv_w, self_w,
+                   wire=None, sent_w=None):
     """Per-worker-block exchange math, callable from any shard_map body
     (the standalone window ops below AND the fused window-optimizer step
     in :mod:`bluefog_tpu.optimizers` share this single source of truth).
@@ -327,13 +355,53 @@ def _exchange_core(axis, mode, perms, slots_const, update_p, max_deg, shape,
     'get': buffers <- w * value_src. ``recv_w`` ([rounds, size]) and
     ``self_w`` ([size]) are runtime operands: per-step varying weights
     (randomized gossip, time-varying push-sum) reuse one compiled program.
+
+    ``wire`` (``window_wire()``) compresses the payload: the sender
+    quantizes ``xb`` ONCE (block-scaled for int8/int4, same quantizers
+    as the combine wires) and every round ships the compressed pair;
+    receivers dequantize before applying their edge weight. In ``'acc'``
+    mode — the push-sum transfer — the sender additionally keeps the
+    quantization residual of the mass it shipped: ``v`` picks up
+    ``sent_w * (x - dequant(Q(x)))`` on top of the ``self_w`` rescale
+    (``sent_w`` [size] = each rank's total outgoing edge weight this
+    call), so the column sum ``self_w*x + sum_d w_d*x_hat + sent*(x -
+    x_hat) == x`` holds EXACTLY — sender mass conservation survives
+    quantization by construction, not to quantization precision
+    (oracle-tested in tests/test_windows.py). put/get replace buffers
+    rather than accumulate mass, so they take the plain bounded
+    rounding error with no absorption. The p lane is never quantized:
+    it is one scalar per rank, and push-sum's x/p correction needs its
+    column sums exact.
     """
     idx = lax.axis_index(axis)
+
+    if wire == "bf16":
+        q16 = lax.optimization_barrier(xb.astype(jnp.bfloat16))
+        xhat = q16.astype(jnp.float32)
+        payload_rounds = [
+            lax.ppermute(q16, axis, perm).astype(jnp.float32)
+            for perm in perms
+        ]
+    elif wire in ("int8", "int4"):
+        quantize, deq_flat = inner._block_quantizer(wire)
+        n = xb.size
+        q, s, xhat_flat = quantize(xb.astype(jnp.float32).ravel())
+        xhat = xhat_flat.reshape(xb.shape)
+        payload_rounds = []
+        for perm in perms:
+            rq = lax.ppermute(q, axis, perm)
+            rs = lax.ppermute(s, axis, perm)
+            payload_rounds.append(deq_flat(rq, rs, n).reshape(xb.shape))
+    else:
+        xhat = None
+        payload_rounds = [lax.ppermute(xb, axis, perm) for perm in perms]
 
     recvs, precvs = [], []
     for r, perm in enumerate(perms):
         wsel = recv_w[r, idx]
-        recvs.append(lax.ppermute(xb, axis, perm) * wsel.astype(v.dtype))
+        recvs.append(
+            payload_rounds[r].astype(v.dtype) * wsel.astype(v.dtype)
+        )
         if update_p:
             precvs.append(
                 lax.ppermute(pv, axis, perm) * wsel.astype(pv.dtype)
@@ -366,23 +434,32 @@ def _exchange_core(axis, mode, perms, slots_const, update_p, max_deg, shape,
 
     sw = self_w[idx]
     new_v = v * sw.astype(v.dtype)
+    if wire is not None and mode == "acc" and sent_w is not None:
+        # sender mass conservation: absorb the quantization residual of
+        # the shipped mass locally (see the docstring's column-sum
+        # identity) — exact in f32 window arithmetic
+        resid = xb.astype(jnp.float32) - xhat
+        new_v = new_v + (
+            sent_w[idx].astype(jnp.float32) * resid
+        ).astype(v.dtype)
     new_p = pv * sw.astype(pv.dtype) if update_p else pv
     return new_v, new_bufs, new_vers, new_p, new_pbufs
 
 
 def _exchange_fn(ctx, win: _Window, mode: str, perms, slot_table,
-                 update_p: bool):
+                 update_p: bool, wire: Optional[str] = None):
     """Compiled shard_map wrapper around :func:`_exchange_core`.
 
-    Keyed on the communication *structure* (perms + slot table), never on
-    weight values — those arrive as replicated operands at dispatch. With
-    ``update_p`` the p lane undergoes the identical exchange (reference
-    gates this on the associated-p switch; off means p stays untouched).
+    Keyed on the communication *structure* (perms + slot table + the
+    wire tier), never on weight values — those arrive as replicated
+    operands at dispatch. With ``update_p`` the p lane undergoes the
+    identical exchange (reference gates this on the associated-p switch;
+    off means p stays untouched).
     """
     axis = ctx_mod.WORKER_AXIS
     key = (
         "win_exchange", mode, perms,
-        tuple(map(tuple, slot_table)), update_p,
+        tuple(map(tuple, slot_table)), update_p, wire,
         win.shape, str(win.dtype),
     )
     cached = ctx.op_cache.get(key)
@@ -394,12 +471,13 @@ def _exchange_fn(ctx, win: _Window, mode: str, perms, slot_table,
     # arrays in op_cache past win_free
     max_deg, shape = win.max_deg, win.shape
 
-    def body(value, buffers, versions, p, p_buffers, x, recv_w, self_w):
+    def body(value, buffers, versions, p, p_buffers, x, recv_w, self_w,
+             sent_w):
         # blocks carry a leading worker axis of 1
         outs = _exchange_core(
             axis, mode, perms, slots_const, update_p, max_deg, shape,
             value[0], buffers[0], versions[0], p[0], p_buffers[0], x[0],
-            recv_w, self_w,
+            recv_w, self_w, wire=wire, sent_w=sent_w,
         )
         return tuple(jnp.expand_dims(t, 0) for t in outs)
 
@@ -407,7 +485,7 @@ def _exchange_fn(ctx, win: _Window, mode: str, perms, slot_table,
     cached = jax.jit(
         jax.shard_map(
             body, mesh=ctx.mesh,
-            in_specs=(spec,) * 6 + (P(), P()), out_specs=(spec,) * 5,
+            in_specs=(spec,) * 6 + (P(), P(), P()), out_specs=(spec,) * 5,
         )
     )
     ctx.op_cache[key] = cached
@@ -443,13 +521,16 @@ def _lowered_exchange(ctx, win, w_edges):
 
 
 def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
-    # window-op accounting: exported alongside the gossip-health metrics
-    # so window-family traffic is visible in the same registry
-    metrics_mod.counter(f"bluefog.window_ops.{mode}").inc()
-    flight.record("window_op", op=mode, window=win.name)
-    self_vec = _self_weight_vec(ctx, self_weight, participating)
-    perms, slot_table = _lowered_exchange(ctx, win, w_edges)
-    fn = _exchange_fn(ctx, win, mode, perms, slot_table, _p_enabled())
+    # validate BEFORE any telemetry (same rule as the compressed
+    # allgather facade): a rejected dispatch must not count as a window
+    # op or leave a flight event for an exchange that never ran
+    wire = window_wire()
+    if wire is not None and not np.issubdtype(np.dtype(win.dtype),
+                                              np.inexact):
+        raise ValueError(
+            f"BLUEFOG_WINDOW_WIRE={wire!r} needs a float window; "
+            f"{win.name!r} holds {win.dtype}"
+        )
     if x is None:
         x = win.value
     else:
@@ -459,10 +540,26 @@ def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
                 f"window {win.name!r} holds shape {win.shape}, got "
                 f"{tuple(x.shape[1:])}"
             )
+    # window-op accounting: exported alongside the gossip-health metrics
+    # so window-family traffic is visible in the same registry
+    metrics_mod.counter(f"bluefog.window_ops.{mode}").inc()
+    flight.record("window_op", op=mode, window=win.name)
+    self_vec = _self_weight_vec(ctx, self_weight, participating)
+    perms, slot_table = _lowered_exchange(ctx, win, w_edges)
+    fn = _exchange_fn(
+        ctx, win, mode, perms, slot_table, _p_enabled(), wire=wire
+    )
+    n_elems = int(np.prod(win.shape)) if win.shape else 1
+    metrics_mod.counter("bluefog.window_wire_bytes").inc(
+        metrics_mod.wire_bytes_per_step(
+            {np.dtype(win.dtype).itemsize: n_elems}, len(perms), wire
+        )
+    )
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
         win.value, win.buffers, win.versions, win.p, win.p_buffers, x,
         jnp.asarray(_round_weights(perms, w_edges)),
         jnp.asarray(np.asarray(self_vec, np.float64)),
+        jnp.asarray(np.asarray(w_edges.sum(axis=1), np.float64)),
     )
     return win
 

@@ -504,3 +504,86 @@ def test_bench_diff_classifies_ambient_vs_real(tmp_path):
     rep2 = compare(a, c, [])
     cell2 = [c2 for c2 in rep2["cells"] if c2["status"] == "paired"][0]
     assert cell2["headline_delta_class"].startswith("real"), cell2
+
+
+def test_quant_evidence_file_committed():
+    """QUANT_EVIDENCE.json (the committed BENCH_MODE=quant output)
+    carries the acceptance facts: every wire tier measured on the same
+    consensus problem, the >=2x int4-vs-int8 wire reduction with the
+    scale sidecar priced in, int4_ef consensus no worse than int8's
+    (within the disclosed multi-seed A/A spread), the push-sum
+    mass-conservation check under the quantized window wire, and the
+    provenance + ambient-anchor contract."""
+    path = os.path.join(REPO, "QUANT_EVIDENCE.json")
+    assert os.path.exists(path), "QUANT_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    tiers = {l["wire"]: l for l in lines if l.get("metric") == "quant_tier"}
+    assert set(tiers) == {
+        "fp32", "bf16", "int8", "int8_ef", "int4", "int4_ef",
+    }, sorted(tiers)
+    for name, t in tiers.items():
+        assert t["wire_bytes_per_step"] > 0
+        assert t["consensus_curve"], name
+        assert t["final_consensus_median"] >= 0
+    # byte ordering: int4 < int8 < bf16 < fp32; ef tiers match their base
+    assert tiers["int4"]["wire_bytes_per_step"] < (
+        tiers["int8"]["wire_bytes_per_step"]
+    ) < tiers["bf16"]["wire_bytes_per_step"] < (
+        tiers["fp32"]["wire_bytes_per_step"]
+    )
+    assert tiers["int4_ef"]["wire_bytes_per_step"] == (
+        tiers["int4"]["wire_bytes_per_step"]
+    )
+    # quant-error telemetry covered the quantized tiers
+    for name in ("int8", "int8_ef", "int4", "int4_ef"):
+        assert tiers[name].get("quant_err_rms", 0) > 0, name
+    summary = [l for l in lines if l.get("metric") == "quant_summary"]
+    assert summary, lines
+    s = summary[0]
+    assert s["wire_reduction_int4_vs_int8"] >= 2.0, s
+    assert s["int4_ef_no_worse_than_int8"] is True, s
+    assert "aa_noise_pct" in s
+    mass = [l for l in lines if l.get("metric") == "quant_window_mass"]
+    assert mass and mass[0]["mass_conserved"] is True, lines
+    assert mass[0]["max_mass_drift"] < mass[0]["mass_bound"]
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_wire_columns_are_tooling_gained(tmp_path):
+    """The quantized-wire evidence adds wire-byte accounting columns to
+    existing cells; against a pre-quant artifact their one-sided
+    appearance must read as tooling-gained-a-column (cell stays
+    comparable), not a timing-harness break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_wire_cols):
+        row = {
+            "metric": "gossip_step", "n_workers": 8,
+            "ms_per_step": 10.0, "median": 10.1, "min": 9.9,
+        }
+        if with_wire_cols:
+            row["wire_bytes_per_step"] = 12384
+            row["effective_compression_ratio"] = 3.97
+        path.write_text(
+            json.dumps(prov) + "\n" + json.dumps(row) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell

@@ -367,3 +367,231 @@ def test_hierarchical_rejects_int8_ef(cpu_devices):
     state = opt.init(params)
     with pytest.raises(ValueError, match="int8_ef"):
         opt.step(params, state, params)
+
+
+# -- the int4 tier (block-scaled nibble-packed, bf16 scales) ------------------
+
+
+@pytest.mark.parametrize("n", [1, 511, 512, 513])
+def test_int4_pack_unpack_roundtrip_oracle(n):
+    """Numpy oracle for the nibble wire at every 512-block remainder
+    width: quantize -> pack -> unpack -> dequantize on device must equal
+    the host replica bit for bit, and pack/unpack must round-trip every
+    int4 value exactly."""
+    from bluefog_tpu import metrics
+
+    rng = np.random.RandomState(n)
+    x = (rng.randn(n) * 3).astype(np.float32)
+
+    dev_q, dev_s, dev_xhat = jax.jit(inner._chunk_quantize4)(
+        jnp.asarray(x)
+    )
+    # host replica reconstructs through the packed wire format
+    np.testing.assert_array_equal(
+        np.asarray(dev_xhat), metrics._np_chunk_quantize4(x)
+    )
+    # receivers reconstruct from the PACKED bits: bitwise the sender's
+    # own xhat (the property the difference form and EF copies rely on)
+    recon = jax.jit(lambda q, s: inner._dequant4(q, s, n))(dev_q, dev_s)
+    np.testing.assert_array_equal(np.asarray(recon), np.asarray(dev_xhat))
+    # pack/unpack is exact for every representable nibble value
+    n_chunks = -(-n // 512)
+    q_all = rng.randint(-7, 8, size=(n_chunks, 512)).astype(np.int8)
+    rt = np.asarray(
+        inner._unpack_nibbles(inner._pack_nibbles(jnp.asarray(q_all)))
+    )
+    np.testing.assert_array_equal(rt, q_all)
+    hostrt = metrics._np_unpack_nibbles(metrics._np_pack_nibbles(q_all))
+    np.testing.assert_array_equal(hostrt, q_all)
+
+
+def test_int4_combine_close_and_fixed_point():
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = np.random.RandomState(20).randn(SIZE, 700).astype(np.float32)
+    exact = np.asarray(bf.neighbor_allreduce(x))
+    quant = np.asarray(bf.neighbor_allreduce(x, compression="int4"))
+    step = np.abs(x).max(axis=1, keepdims=True) / 7.0
+    assert np.abs(quant - exact).max() < 1.5 * step.max()
+    assert not np.array_equal(quant, exact)
+    # consensus is an exact fixed point (difference form)
+    c = np.tile(x[:1], (SIZE, 1))
+    out = np.asarray(bf.neighbor_allreduce(c, compression="int4"))
+    np.testing.assert_allclose(out, c, rtol=1e-6, atol=1e-7)
+
+
+def test_int4_wire_bytes_are_one_eighth_and_2x_vs_int8():
+    """HLO proof of the 8x-vs-f32 / 2x-vs-int8 claims: packed nibbles +
+    bf16 block scales. The byte accounting (scale sidecar included) is
+    exactly 2x at every payload width; the CPU backend's optimized HLO
+    widens the bf16 scale sidecar to f32 (its collective legalization,
+    same as the bf16 wire — TPU ships it natively), so the HLO-counted
+    ratio is bounded slightly under 2."""
+    D = 4096
+    plan = planlib.plan_from_topology(tu.RingGraph(SIZE), weighted=True)
+    mesh = bf.get_context().mesh
+    spec = P("workers")
+
+    def lower(wire):
+        import functools
+
+        combine = (
+            inner.weighted_combine if wire is None
+            else functools.partial(
+                inner.weighted_combine_quantized, wire=wire
+            )
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                lambda t: combine(t, plan, "workers"),
+                mesh=mesh, in_specs=spec, out_specs=spec,
+            )
+        )
+        x = jax.device_put(
+            jnp.zeros((SIZE, D), jnp.float32), NamedSharding(mesh, spec)
+        )
+        return scaling.hlo_collective_stats(
+            fn.lower(x).compile().as_text()
+        )["collective-permute"]
+
+    base, q8, q4 = lower(None), lower("int8"), lower("int4")
+    assert q4["bytes"] <= int(base["bytes"] // 8 * 1.05), (base, q4)
+    assert q8["bytes"] / q4["bytes"] > 1.9, (q8, q4)
+    # the accounting (what the chooser and the evidence price) is exact
+    for n in (1, 511, 512, 513, D):
+        assert scaling.wire_payload_bytes(n, 4, "int8") == (
+            2 * scaling.wire_payload_bytes(n, 4, "int4")
+        ), n
+
+
+def test_int4_scales_ride_bf16_on_the_wire():
+    """The lowering ships the block scales as bf16 (the sidecar that
+    preserves the full 2x vs int8); bind to the emitted collective's
+    own types like the bf16-wire test."""
+    import re
+
+    D = 4096
+    plan = planlib.plan_from_topology(tu.RingGraph(SIZE), weighted=True)
+    mesh = bf.get_context().mesh
+    spec = P("workers")
+    fn = jax.jit(
+        jax.shard_map(
+            lambda t: inner.weighted_combine_quantized(
+                t, plan, "workers", wire="int4"
+            ),
+            mesh=mesh, in_specs=spec, out_specs=spec,
+        )
+    )
+    xd = jax.device_put(jnp.zeros((SIZE, D), jnp.float32),
+                        NamedSharding(mesh, spec))
+    lowered = fn.lower(xd).as_text()
+    cp_types = re.findall(
+        r"collective_permute.*?->\s*tensor<([^>]+)>", lowered
+    )
+    assert any("i8" in t and "256" in t for t in cp_types), cp_types
+    assert any("bf16" in t for t in cp_types), cp_types
+
+
+def test_int4_optimizer_converges():
+    c = np.random.RandomState(21).randn(SIZE, 4).astype(np.float32)
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 10, 0.5))
+    )
+    opt.compression = "int4"
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for _ in range(60):
+        params, state = opt.step(params, state,
+                                 {"w": params["w"] - jnp.asarray(c)})
+    w = np.asarray(params["w"])
+    target = c.mean(0)
+    assert np.abs(w - target).max() < 0.2 * np.abs(c - target).max()
+
+
+def test_int4_ef_removes_int4_noise_floor():
+    """Plain int4's quantization floor is far coarser than int8's; the
+    CHOCO error-feedback tier erases it the same way int8_ef erases
+    int8's — the fact that makes a 4-bit wire trajectory-safe."""
+    c = np.random.RandomState(22).randn(SIZE, 640).astype(np.float32) * 5.0
+    zero = {"w": jnp.zeros((SIZE, 640), jnp.float32)}
+
+    def run(compression):
+        opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+        opt.compression = compression
+        params = {"w": bf.worker_values(lambda r: c[r])}
+        state = opt.init(params)
+        for _ in range(150):
+            params, state = opt.step(params, state, zero)
+        w = np.asarray(params["w"])
+        return np.abs(w - w.mean(0)).max()
+
+    spread_plain = run("int4")
+    spread_ef = run("int4_ef")
+    assert spread_ef < 0.01 * spread_plain, (spread_plain, spread_ef)
+    assert spread_ef < 1e-3
+
+
+def test_int4_ef_restricted_paths():
+    opt = bf.DistributedAllreduceOptimizer(optax.sgd(0.1))
+    opt.compression = "int4_ef"
+    params = {"w": bf.worker_values(lambda r: np.ones(4, np.float32))}
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="int4_ef"):
+        opt.step(params, state, params)
+
+    opt2 = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    opt2.compression = "int4_ef"
+    state2 = opt2.init(params)
+    train_step = opt2.make_train_step(
+        lambda p, t: jnp.sum(p["w"] * t), delayed=True
+    )
+    with pytest.raises(ValueError, match="int4_ef"):
+        train_step(params, state2, params["w"])
+
+
+def test_hierarchical_int4_converges(cpu_devices):
+    """int4 on the machine-level (DCN) leg: the 8x-compressed cross-host
+    gossip still reaches consensus."""
+    bf.shutdown()
+    bf.init(devices=cpu_devices[:SIZE], nodes_per_machine=4)
+    bf.set_machine_topology(tu.RingGraph(2))
+    c = np.random.RandomState(23).randn(SIZE, 4).astype(np.float32)
+    opt = bf.DistributedHierarchicalNeighborAllreduceOptimizer(
+        optax.sgd(optax.exponential_decay(0.3, 10, 0.5))
+    )
+    opt.compression = "int4"
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    for _ in range(60):
+        params, state = opt.step(params, state,
+                                 {"w": params["w"] - jnp.asarray(c)})
+    w = np.asarray(params["w"])
+    target = c.mean(0)
+    assert np.abs(w - target).max() < 0.2 * np.abs(c - target).max()
+    assert np.abs(w - w.mean(0)).max() < 0.15
+
+
+def test_quantized_allgather_all_wires():
+    """Compressed neighbor_allgather: every wire returns a bounded
+    approximation of the exact gather (bf16 near-lossless, int8/int4 at
+    their block-scaled steps), same neighbor order and shapes."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    x = np.random.RandomState(24).randn(SIZE, 600).astype(np.float32)
+    exact = bf.neighbor_allgather(x)
+    steps = {"bf16": 0.02 * np.abs(x).max(),
+             "int8": np.abs(x).max() / 127.0 * 1.5,
+             "int4": np.abs(x).max() / 7.0 * 1.5}
+    for wire, bound in steps.items():
+        got = bf.neighbor_allgather(x, compression=wire)
+        assert len(got) == len(exact)
+        for e, g in zip(exact, got):
+            assert np.asarray(g).shape == np.asarray(e).shape
+            assert np.abs(np.asarray(g) - np.asarray(e)).max() < bound, (
+                wire
+            )
+    with pytest.raises(ValueError, match="int4"):
+        bf.neighbor_allgather(x, compression="fp4")
+    with pytest.raises(ValueError, match="float"):
+        bf.neighbor_allgather(
+            bf.worker_values(lambda r: np.ones(8, np.int32)),
+            compression="int8",
+        )

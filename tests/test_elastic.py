@@ -932,3 +932,43 @@ def test_simultaneous_kills_all_detected_in_one_repair():
     assert session.repairs[0].steps_to_detect == {2: 0, 6: 0}
     assert session._unrepaired == {}
     assert session.stale_dispatches == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("wire", ["int8_ef", "int4_ef"])
+def test_chaos_kill_ef_residuals_self_invalidate(wire):
+    """Repair under an active error-feedback session (int8_ef AND the
+    int4_ef tier): the CHOCO copies integrate a fixed per-round source,
+    so the membership change must zero-rebuild them — stale copies
+    integrated under the pre-failure edge set would desynchronize the
+    bit-identical sender/receiver replicas. After the rebuild the EF
+    recursion re-converges: survivors reach a consensus far below the
+    memoryless tier's quantization floor."""
+    _init()
+    bf.set_topology(bf.topology.ExponentialTwoGraph(SIZE))
+    session = bf.elastic.start(policy="average")
+    session.inject("kill", rank=3, step=5)
+    opt = bf.DistributedAdaptWithCombineOptimizer(optax.sgd(0.0))
+    opt.compression = wire
+    guard = bf.elastic.guard(opt)
+    rng = np.random.RandomState(17)
+    x0 = rng.randn(SIZE, 1024).astype(np.float32) * 4.0
+    params = {"w": bf.worker_values(lambda r: x0[r])}
+    state = opt.init(params)
+    zero = {"w": bf.worker_values(lambda r: np.zeros(1024, np.float32))}
+    ef_sig_pre = ef_pre = None
+    for t in range(60):
+        params, state = guard.step(params, state, zero)
+        if t == 4:  # one step before the kill lands
+            ef_sig_pre = opt._ef_sig
+            ef_pre = opt._ef
+    assert ef_sig_pre is not None
+    # the repaired plan's perms differ -> the EF signature changed and
+    # the copies were rebuilt (zeroed), not carried across the repair
+    assert opt._ef_sig != ef_sig_pre
+    assert opt._ef is not ef_pre
+    live = sorted(session.membership.live_ranks())
+    assert 3 not in live
+    w = np.asarray(params["w"])[live]
+    assert np.abs(w - w.mean(0)).max() < 1e-2, wire
+    bf.elastic.stop()

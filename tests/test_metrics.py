@@ -164,7 +164,9 @@ def test_quant_err_and_ef_residual_populate(monkeypatch):
     monkeypatch.setenv("BLUEFOG_METRICS", "1")
     monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
     c = np.random.RandomState(0).randn(SIZE, 600).astype(np.float32)
-    for wire, slot in (("int8", "quant_err"), ("int8_ef", "ef_residual")):
+    for wire, slot in (("int8", "quant_err"), ("int8_ef", "ef_residual"),
+                       ("int4", "quant_err"), ("int4_ef", "ef_residual")):
+        metrics.reset()
         opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
         opt.compression = wire
         params = {"w": bf.worker_values(lambda r: c[r])}
@@ -173,12 +175,63 @@ def test_quant_err_and_ef_residual_populate(monkeypatch):
         metrics.flush()
         val = metrics.snapshot()[f"bluefog.gossip.{slot}"]["value"]
         assert val > 0.0, (wire, slot)
-    # int8_ef: CHOCO identity — this step's quantization error IS the
-    # new residual
+        if wire.endswith("_ef"):
+            # CHOCO identity — this step's quantization error IS the
+            # new residual
+            snap = metrics.snapshot()
+            assert (
+                snap["bluefog.gossip.quant_err"]["value"]
+                == snap["bluefog.gossip.ef_residual"]["value"]
+            ), wire
+
+
+def test_int4_probe_matches_host_replay(monkeypatch):
+    """The int4 quant-err fold replays the exact wire format: the gauge
+    equals the numpy-oracle RMS of ``x - dequant(pack(Q(x)))`` over the
+    covered prefix (the sub-gossip probe ships raw input slices, so the
+    host replica must be bit-faithful for the number to mean
+    anything)."""
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    monkeypatch.setenv("BLUEFOG_METRICS_INTERVAL", "1")
+    c = np.random.RandomState(5).randn(SIZE, 600).astype(np.float32)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    opt.compression = "int4"
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    s = opt.init(params)
+    opt.step(params, s, {"w": jnp.zeros_like(params["w"])})
+    metrics.flush()
+    got = metrics.snapshot()["bluefog.gossip.quant_err"]["value"]
+    per_worker = np.asarray([
+        np.sqrt(((c[w] - metrics._np_chunk_quantize4(c[w])) ** 2).sum())
+        for w in range(SIZE)
+    ])
+    np.testing.assert_allclose(got, per_worker.mean(), rtol=1e-5)
+
+
+def test_allgather_wire_telemetry(monkeypatch):
+    """The compressed neighbor_allgather populates its own quant-error
+    gauges and wire-byte counter (scale sidecar included); the exact
+    gather does not touch them."""
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    x = np.random.RandomState(6).randn(SIZE, 600).astype(np.float32)
+    bf.neighbor_allgather(x)
+    assert "bluefog.allgather.quant_err" not in metrics.snapshot()
+    bf.neighbor_allgather(x, compression="int4")
     snap = metrics.snapshot()
-    assert (
-        snap["bluefog.gossip.quant_err"]["value"]
-        == snap["bluefog.gossip.ef_residual"]["value"]
+    got = snap["bluefog.allgather.quant_err"]["value"]
+    per_worker = np.asarray([
+        np.sqrt(
+            ((x[w] - metrics._np_chunk_quantize4(x[w])) ** 2).sum() / 600
+        )
+        for w in range(SIZE)
+    ])
+    np.testing.assert_allclose(got, per_worker.mean(), rtol=1e-5)
+    from bluefog_tpu import scaling
+    from bluefog_tpu.collective.plan import plan_from_topology
+
+    plan = plan_from_topology(tu.ExponentialTwoGraph(SIZE), weighted=True)
+    assert snap["bluefog.allgather.wire_bytes"]["value"] == (
+        len(plan.rounds) * scaling.wire_payload_bytes(600, 4, "int4")
     )
 
 
@@ -265,11 +318,12 @@ def _run_steps(order, wire, enabled, c, monkeypatch, fused):
 
 
 @pytest.mark.parametrize("order", ["cta", "atc"])
-@pytest.mark.parametrize("wire", [None, "int8", "int8_ef"])
+@pytest.mark.parametrize("wire", [None, "int8", "int8_ef", "int4",
+                                  "int4_ef"])
 def test_metrics_on_off_bitwise_identical(order, wire, monkeypatch):
     """THE pin: enabling metrics recompiles the step with extra outputs
     but must not perturb params or optimizer state by a single bit, for
-    ATC/CTA x fp32/int8/int8_ef."""
+    ATC/CTA x fp32/int8/int8_ef/int4/int4_ef."""
     c = np.random.RandomState(1).randn(SIZE, 700).astype(np.float32)
     p_off, s_off = _run_steps(order, wire, False, c, monkeypatch, False)
     p_on, s_on = _run_steps(order, wire, True, c, monkeypatch, False)

@@ -166,11 +166,13 @@ def test_bucketed_gossip_bitwise_matches_monolithic(monkeypatch):
     assert_trees_bitwise(results["0"][1], results["2048"][1])
 
 
-def test_bucketed_int8_ef_bitwise_matches_monolithic(monkeypatch):
+@pytest.mark.parametrize("wire", ["int8_ef", "int4_ef"])
+def test_bucketed_ef_bitwise_matches_monolithic(wire, monkeypatch):
     """Error-feedback compression under bucketing: the residual state is
     sliced with the payload and bucket bounds snap to the quantization
-    chunk, so bucketed int8_ef is bitwise the monolithic wire — state
-    included."""
+    chunk, so bucketed int8_ef / int4_ef is bitwise the monolithic
+    wire — state included (int4_ef additionally exercises the packed
+    nibble wire across bucket boundaries)."""
     n = 2048
     rng = np.random.RandomState(3)
     c = rng.randn(SIZE, n).astype(np.float32)
@@ -178,7 +180,7 @@ def test_bucketed_int8_ef_bitwise_matches_monolithic(monkeypatch):
     for cap in ("0", "4096"):  # 1024-elem buckets, 512-aligned
         monkeypatch.setenv("BLUEFOG_BUCKET_BYTES", cap)
         opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
-        opt.compression = "int8_ef"
+        opt.compression = wire
         params = {"w": bf.worker_values(lambda r: c[r])}
         s = opt.init(params)
         p = params
@@ -187,6 +189,36 @@ def test_bucketed_int8_ef_bitwise_matches_monolithic(monkeypatch):
         results[cap] = (p, opt._ef)
     assert_trees_bitwise(results["0"][0], results["4096"][0])
     assert_trees_bitwise(results["0"][1], results["4096"][1])
+
+
+def test_fused_int4_bitwise_matches_two_program(monkeypatch):
+    """The fused train step with the int4 wire == grad-program +
+    opt.step, to the bit, bucketed — the new tier rides the shared
+    _combine_update core like every other wire."""
+    monkeypatch.setenv("BLUEFOG_BUCKET_BYTES", "4096")
+    n = 2048
+    rng = np.random.RandomState(9)
+    c = rng.randn(SIZE, n).astype(np.float32)
+    cvals = bf.worker_values(lambda r: c[r])
+
+    def loss_fn(p, cv):
+        return 0.5 * jnp.sum((p["w"] - cv) ** 2)
+
+    opt1 = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    opt1.compression = "int4"
+    params = {"w": bf.worker_values(lambda r: c[r] + 1.0)}
+    p1, s1 = params, opt1.init(params)
+    grad_fn = legacy_grad_fn(loss_fn, params)
+    opt2 = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    opt2.compression = "int4"
+    p2, s2 = params, opt2.init(params)
+    train_step = opt2.make_train_step(loss_fn)
+    for _ in range(3):
+        g = grad_fn(p1, cvals)
+        p1, s1 = opt1.step(p1, s1, g)
+        p2, s2, _loss = train_step(p2, s2, cvals)
+    assert_trees_bitwise(p1, p2)
+    assert_trees_bitwise(s1, s2)
 
 
 def test_fused_gradient_allreduce_matches_two_program():

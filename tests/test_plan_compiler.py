@@ -434,7 +434,7 @@ def test_chunked_combine_bitwise_all_wires(elems):
             ), x,
         ))
         np.testing.assert_array_equal(base, got), k
-    for wire in ("int8", "bf16"):
+    for wire in ("int8", "bf16", "int4"):
         qbase = np.asarray(run_spmd(
             functools.partial(
                 inner.weighted_combine_quantized, plan=plan,
@@ -451,9 +451,12 @@ def test_chunked_combine_bitwise_all_wires(elems):
             np.testing.assert_array_equal(qbase, got), (wire, k)
 
 
-def test_chunked_ef_bitwise_output_and_state():
-    """int8_ef chunked == monolithic for output AND both CHOCO copies:
-    the state is positional over the flat payload and slices with it."""
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+def test_chunked_ef_bitwise_output_and_state(wire):
+    """int8_ef / int4_ef chunked == monolithic for output AND both CHOCO
+    copies: the state is positional over the flat payload and slices
+    with it (int4 additionally pins that per-chunk nibble-pack slices
+    are whole scale groups)."""
     import jax.numpy as jnp
 
     g = topo.RandomRegularDigraph(SIZE, 2, seed=3)
@@ -471,7 +474,7 @@ def test_chunked_ef_bitwise_output_and_state():
         def body(t, es, er):
             y, (es2, er2) = inner.weighted_combine_quantized_ef_operands(
                 t, (es[0], er[0]), perms, jnp.asarray(recv_w), AXIS,
-                chunks=chunks,
+                chunks=chunks, wire=wire,
             )
             return y, jnp.expand_dims(es2, 0), jnp.expand_dims(er2, 0)
         out = run_spmd(
@@ -612,12 +615,14 @@ def test_eager_cache_keys_unique_per_chunk_and_route(monkeypatch):
 
 
 @pytest.mark.parametrize("order", ["atc", "cta"])
-@pytest.mark.parametrize("wire", [None, "int8", "int8_ef"])
+@pytest.mark.parametrize(
+    "wire", [None, "int8", "int8_ef", "int4", "int4_ef"]
+)
 def test_optimizer_chunked_trajectory_bitwise(order, wire, monkeypatch):
     """The acceptance pin: BLUEFOG_PLAN_CHUNKS=4 vs =1 optimizer
-    trajectories are bitwise-identical for ATC/CTA x fp32/int8/int8_ef
-    (PR-2 buckets are the chunking grain; chunking is a schedule
-    change, never a numerics change)."""
+    trajectories are bitwise-identical for ATC/CTA x
+    fp32/int8/int8_ef/int4/int4_ef (PR-2 buckets are the chunking
+    grain; chunking is a schedule change, never a numerics change)."""
     import bluefog_tpu as bf
     import optax
 

@@ -409,3 +409,123 @@ def test_host_weight_resolution_cost():
     per_step = (time.perf_counter() - t0) / reps
     assert len(ctx.op_cache) == n_keys, "warm calls must not re-lower"
     assert per_step < 0.010, f"host weight resolution {per_step*1e3:.2f} ms"
+
+
+# -- quantized window wire (BLUEFOG_WINDOW_WIRE) ------------------------------
+
+
+def test_window_wire_env_validation(monkeypatch):
+    from bluefog_tpu import windows as win_mod
+
+    for v, want in (("", None), ("off", None), ("fp32", None),
+                    ("bf16", "bf16"), ("INT8", "int8"), ("int4", "int4")):
+        monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", v)
+        assert win_mod.window_wire() == want, v
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", "fp4")
+    with pytest.raises(ValueError, match="BLUEFOG_WINDOW_WIRE"):
+        win_mod.window_wire()
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8", "int4"])
+def test_quantized_win_put_matches_numpy_oracle(wire, monkeypatch):
+    """win_put under a quantized wire: each destination's buffer holds
+    ``w * dequant(Q(x))`` with the SAME reconstruction the host replica
+    computes — the oracle is the numpy quantizer, not a tolerance."""
+    import ml_dtypes
+
+    from bluefog_tpu import metrics as bf_metrics
+
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", wire)
+    rng = np.random.RandomState(31)
+    vals = rng.randn(SIZE, 600).astype(np.float32) * 3
+    x = bf.worker_values(lambda r: vals[r])
+    bf.win_create(x, "qw")
+    bf.win_put(name="qw", self_weight=1.0,
+               dst_weights=[{(r + 1) % SIZE: 0.5} for r in range(SIZE)])
+    from bluefog_tpu import windows as win_mod
+
+    win = win_mod._get_win(bf.get_context(), "qw")
+    bufs = np.asarray(win.buffers)
+    for r in range(SIZE):
+        src = (r - 1) % SIZE
+        slot = win.in_neighbors[r].index(src)
+        v = vals[src]
+        if wire == "bf16":
+            hat = v.astype(ml_dtypes.bfloat16).astype(np.float32)
+        elif wire == "int8":
+            hat = bf_metrics._np_chunk_quantize(v)
+        else:
+            hat = bf_metrics._np_chunk_quantize4(v)
+        np.testing.assert_array_equal(bufs[r, slot], 0.5 * hat)
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8", "int4"])
+def test_push_sum_mass_conserved_under_quantized_wire(wire, monkeypatch):
+    """THE quantized-windows acceptance oracle: under any wire tier the
+    push-sum accumulate conserves sender mass EXACTLY (to f32 rounding
+    of the running sums) — the sender absorbs the quantization residual
+    of the mass it ships — and the p lane (never quantized) stays an
+    exact column-stochastic recursion. The x/p estimate still reaches
+    the true average to within the wire's noise floor."""
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", wire)
+    from bluefog_tpu import windows as win_mod
+
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    bf.turn_on_win_ops_with_associated_p()
+    x0 = np.random.RandomState(32).randn(SIZE, 600).astype(np.float32) * 3
+    bf.win_create(bf.worker_values(lambda r: x0[r]), "psq", zero_init=True)
+    outs = bf.get_context().out_neighbor_ranks()
+    dst = [
+        {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]} for r in range(SIZE)
+    ]
+    sw = [1.0 / (len(outs[r]) + 1) for r in range(SIZE)]
+    total0 = x0.sum(0, dtype=np.float64)
+    for _ in range(15):
+        bf.win_accumulate(name="psq", self_weight=sw, dst_weights=dst)
+        bf.win_update_then_collect("psq")
+        v = np.asarray(bf.win_read("psq"), np.float64)
+        # f32 rounding of the running sums only — NOT quantization
+        # magnitude (plain quantized shipping without the residual
+        # absorption drifts ~1e-1 on this problem)
+        assert np.abs(v.sum(0) - total0).max() < 5e-4
+    p = win_mod.win_associated_p("psq")
+    np.testing.assert_allclose(p.sum(), SIZE, rtol=1e-6)
+    est = np.asarray(bf.win_read("psq")) / p[:, None].astype(np.float32)
+    noise = {"bf16": 0.05, "int8": 0.1, "int4": 0.6}[wire]
+    assert np.abs(est - x0.mean(0)).max() < noise
+
+
+def test_quantized_window_rejects_integer_window(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", "int8")
+    x = bf.worker_values(lambda r: np.ones(4, np.float32))
+    bf.win_create(x, "f_ok")
+    bf.win_put(name="f_ok")  # float window: fine
+    monkeypatch.delenv("BLUEFOG_WINDOW_WIRE")
+    xi = bf.worker_values(lambda r: np.ones(4, np.int32))
+    bf.win_create(xi, "i_win")
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", "int8")
+    with pytest.raises(ValueError, match="float window"):
+        bf.win_put(name="i_win")
+
+
+def test_window_optimizer_push_sum_quantized_wire(monkeypatch):
+    """The fused window-optimizer step honors BLUEFOG_WINDOW_WIRE: the
+    push-sum optimizer still converges to the survivor average under
+    the int4 wire (mass conservation holds through the fused exchange
+    too), and the wire tier keys its own compiled program."""
+    import optax
+
+    monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", "int4")
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    c = np.random.RandomState(33).randn(SIZE, 16).astype(np.float32)
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.0))
+    params = {"w": bf.worker_values(lambda r: c[r])}
+    state = opt.init(params)
+    zero = {"w": np.zeros((SIZE, 16), np.float32)}
+    for _ in range(40):
+        params, state = opt.step(state, zero)
+    w = np.asarray(opt.params()["w"])
+    assert np.abs(w - c.mean(0)).max() < 0.25 * np.abs(
+        c - c.mean(0)
+    ).max()
+    opt.free()

@@ -67,6 +67,20 @@ HARNESS_KEYS = {
 # disables ambient classification for that pair.
 ANCHOR_DERIVED = {"vs_anchor"}
 
+# Wire-byte accounting columns that arrived with the quantized-wire
+# evidence family (scale-sidecar-inclusive pricing): like ANCHOR_DERIVED
+# they are static accounting derived from the config, not timed
+# measurements, so their one-sided appearance against an older artifact
+# is the tooling gaining a column — never a timing-harness change.
+WIRE_DERIVED = {
+    "wire_bytes_per_step", "wire_bytes_per_round", "wire_bytes_int8",
+    "wire_bytes_int4", "wire_bytes_int4_ef", "effective_compression_ratio",
+    "wire_reduction_int4_vs_int8",
+}
+
+# Every one-sided-tolerated derived column set.
+TOOLING_DERIVED = ANCHOR_DERIVED | WIRE_DERIVED
+
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
 
 
@@ -247,8 +261,8 @@ def compare(path_a: str, path_b: str, notes: List[str]) -> dict:
             continue
         va, vb = cell_values(a), cell_values(b)
         shared = sorted(set(va) & set(vb))
-        only_a = sorted(set(va) - set(vb) - ANCHOR_DERIVED)
-        only_b = sorted(set(vb) - set(va) - ANCHOR_DERIVED)
+        only_a = sorted(set(va) - set(vb) - TOOLING_DERIVED)
+        only_b = sorted(set(vb) - set(va) - TOOLING_DERIVED)
         floors = [
             f for f in (noise_floor_pct(a), noise_floor_pct(b))
             if f is not None
