@@ -90,6 +90,7 @@ from bluefog_tpu import flight
 from bluefog_tpu.flight import dump as flight_dump
 from bluefog_tpu import attribution
 from bluefog_tpu import attribution as doctor  # bf.doctor facade
+from bluefog_tpu import health
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
     metrics_export,
@@ -337,6 +338,7 @@ __all__ = [
     "flight_dump",
     "attribution",
     "doctor",
+    "health",
     "metrics",
     "metrics_snapshot",
     "metrics_export",

@@ -262,6 +262,11 @@ class StepDoctor:
         self._last_counters: Dict[str, float] = {}
         self.samples: collections.deque = collections.deque(maxlen=history)
         self.advisories: List[Advisory] = []
+        # comm-step count at each emit, parallel to ``advisories`` —
+        # recency consumers (the health plane's /healthz verdict)
+        # compare this clock, not Advisory.step, which under K>1
+        # gradient accumulation counts non-communicating steps too
+        self.advisory_marks: List[int] = []
         self.trackers: Dict[str, BaselineTracker] = {}
         self._consensus_streak = 0
         self._ambient_streak = 0
@@ -749,6 +754,7 @@ class StepDoctor:
         from bluefog_tpu import timeline as tl
 
         self.advisories.append(adv)
+        self.advisory_marks.append(self._count)
         metrics_mod.counter(
             f"bluefog.doctor.advisory.{adv.kind}"
         ).inc()

@@ -371,6 +371,7 @@ def init(
     # init (operations.cc:464-473).
     from bluefog_tpu import attribution as _attribution
     from bluefog_tpu import flight as _flight
+    from bluefog_tpu import health as _health
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
@@ -382,6 +383,10 @@ def init(
     # Attribution doctor (BLUEFOG_DOCTOR=1): fresh session per mesh so
     # stale baselines never advise a new topology.
     _attribution.on_init(_context)
+    # Fleet health plane (BLUEFOG_HEALTH=1 observatory,
+    # BLUEFOG_HEALTH_PORT serving): fresh session per mesh, same
+    # stale-baseline rationale as the doctor.
+    _health.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -399,11 +404,13 @@ def shutdown() -> None:
     from bluefog_tpu import attribution as _attribution
     from bluefog_tpu import elastic as _elastic
     from bluefog_tpu import flight as _flight
+    from bluefog_tpu import health as _health
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
     _elastic.stop()
     _attribution.on_shutdown()
+    _health.on_shutdown()
     if _context is not None:
         # session_end lands in the ring (and the crash hooks detach)
         # while the timeline is still open for the clock pairing

@@ -78,7 +78,9 @@ __all__ = [
     "register_flush_hook",
     "export_jsonl",
     "export_prom",
+    "prom_lines",
     "export_timeline_counters",
+    "last_worker_rows",
     "metrics_export",
     "N_SLOTS",
     "SLOT_COUNT",
@@ -135,13 +137,28 @@ class Gauge:
 
 
 class Histogram:
-    """Running summary (count / sum / min / max / last) of observations.
+    """Running summary (count / sum / min / max / last) plus bounded
+    log-bucket tail quantiles (p50 / p90 / p99).
 
-    Not bucketed: the exporters here feed dashboards and JSONL diffs, and
-    a five-number summary per drain interval is what those consume; full
-    distributions belong in the profiler tier."""
+    The five-number summary feeds dashboards and JSONL diffs; the
+    quantiles answer the question a five-number summary cannot — "what
+    is tail latency" — without unbounded storage: observations land in
+    logarithmic buckets (:data:`_LOG_RES` per octave, clamped to a
+    fixed index range), so memory is O(1) in the observation count and
+    a reported quantile is within one bucket (≈ ``2**(1/(2*_LOG_RES))``,
+    ~9 % relative) of the true order statistic. Exact distributions
+    belong in the profiler tier."""
 
     kind = "histogram"
+
+    # log-bucket resolution: buckets per octave. Reported quantiles are
+    # within 2**(1/(2*_LOG_RES)) (~9%) of the true value.
+    _LOG_RES = 4
+    # clamp indices to [2**-40, 2**40] (~1e-12 .. ~1e12): 321 buckets
+    # max, so a hostile series cannot grow the dict without bound
+    _IDX_MIN = -40 * _LOG_RES
+    _IDX_MAX = 40 * _LOG_RES
+    QUANTILES = (0.5, 0.9, 0.99)
 
     def __init__(self):
         self.count = 0
@@ -149,18 +166,51 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last = 0.0
+        self._buckets: Dict[int, int] = {}
+
+    def _bucket(self, v: float) -> int:
+        import math
+
+        if v <= 0.0:
+            # zero / negative observations share the underflow bucket:
+            # the quantile walk reports them as "at or below 2^-40"
+            return self._IDX_MIN
+        idx = round(self._LOG_RES * math.log2(v))
+        return max(self._IDX_MIN, min(self._IDX_MAX, idx))
 
     def observe(self, v: float) -> None:
         v = float(v)
+        b = self._bucket(v)
         with _lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
+            self._buckets[b] = self._buckets.get(b, 0) + 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The ``q``-quantile from the log buckets (None when empty).
+        Representative value is the bucket's log-space center, clamped
+        into the exact observed [min, max] envelope so a one-bucket
+        histogram reports its own numbers."""
+        with _lock:
+            if self.count == 0:
+                return None
+            need = q * self.count
+            seen = 0
+            idx = self._IDX_MAX
+            for idx in sorted(self._buckets):
+                seen += self._buckets[idx]
+                if seen >= need:
+                    break
+            v = 2.0 ** (idx / self._LOG_RES)
+            lo = self.min if self.min is not None else v
+            hi = self.max if self.max is not None else v
+            return float(min(max(v, lo), hi))
 
     def describe(self) -> dict:
-        return {
+        out = {
             "type": self.kind,
             "count": self.count,
             "sum": self.sum,
@@ -168,6 +218,10 @@ class Histogram:
             "max": self.max,
             "last": self.last,
         }
+        if self.count:
+            for q in self.QUANTILES:
+                out[f"p{int(q * 100)}"] = self.quantile(q)
+        return out
 
 
 def _series(name: str, cls):
@@ -219,6 +273,7 @@ def reset() -> None:
     global _allgather_calls
     with _lock:
         _registry.clear()
+        _last_worker_rows.clear()
         _allgather_calls = 0
 
 
@@ -504,11 +559,29 @@ def drain_device_buffer(buf, prefix: str = "bluefog.gossip",
         mean_v, max_v = float(rms.mean()), float(rms.max())
         gauge(f"{prefix}.{name}").set(mean_v)
         gauge(f"{prefix}.{name}.max").set(max_v)
+        with _lock:
+            # the PER-WORKER vector behind the mean/.max gauges: the
+            # fleet health plane seeds its push-sum lane from this
+            _last_worker_rows[f"{prefix}.{name}"] = rms.copy()
         out[name] = mean_v
         out[f"{name}.max"] = max_v
     if export:
         auto_export()
     return out
+
+
+# Per-worker RMS vectors of the most recent device drain, keyed by the
+# published gauge name. The registry only keeps mean/.max scalars; the
+# health plane's per-rank summary vector needs the worker axis back.
+_last_worker_rows: Dict[str, object] = {}
+
+
+def last_worker_rows() -> Dict[str, object]:
+    """``{series: per-worker numpy vector}`` from the most recent device
+    drain (empty before the first drain / when the device tier is off).
+    Read-only view for :mod:`bluefog_tpu.health`."""
+    with _lock:
+        return dict(_last_worker_rows)
 
 
 # -- deferred-drain flush hooks ----------------------------------------------
@@ -631,34 +704,53 @@ def _prom_name(name: str) -> str:
     return out if not out[:1].isdigit() else "_" + out
 
 
-def export_prom(path: Optional[str] = None) -> Optional[str]:
-    """Write the registry in Prometheus textfile-collector format to
-    ``path`` (default ``BLUEFOG_METRICS_PROM``), atomically (write to
-    ``<path>.tmp`` then rename — node_exporter may scrape mid-write).
-    Counter names get the conventional ``_total`` suffix; histograms
-    export ``_count`` / ``_sum`` / ``_min`` / ``_max``."""
-    path = path or os.environ.get("BLUEFOG_METRICS_PROM")
-    if not path:
-        return None
+def prom_lines() -> list:
+    """The registry rendered as Prometheus exposition lines, in
+    DETERMINISTIC order (series sorted by raw name; fixed sub-line
+    order per series) with the conventional ``# HELP`` / ``# TYPE``
+    preamble per family — successive scrapes/textfiles of an unchanged
+    registry are byte-identical, so they diff cleanly. Counter names
+    get ``_total``; histograms export as a summary:
+    ``_count`` / ``_sum`` / ``_min`` / ``_max`` plus the log-bucket
+    ``{quantile="..."}`` series. Shared by the textfile exporter and
+    the live ``/metrics`` endpoint (:mod:`bluefog_tpu.health`)."""
     lines = []
-    for name, desc in snapshot().items():
+    for name, desc in sorted(snapshot().items()):
         pname = _prom_name(name)
         if desc["type"] == "counter":
+            lines.append(f"# HELP {pname}_total bluefog_tpu series "
+                         f"{name}")
             lines.append(f"# TYPE {pname}_total counter")
             lines.append(f"{pname}_total {desc['value']:g}")
         elif desc["type"] == "gauge":
+            lines.append(f"# HELP {pname} bluefog_tpu series {name}")
             lines.append(f"# TYPE {pname} gauge")
             lines.append(f"{pname} {desc['value']:g}")
         else:
+            lines.append(f"# HELP {pname} bluefog_tpu series {name}")
             lines.append(f"# TYPE {pname} summary")
+            for q in Histogram.QUANTILES:
+                v = desc.get(f"p{int(q * 100)}")
+                if v is not None:
+                    lines.append(f'{pname}{{quantile="{q:g}"}} {v:g}')
             lines.append(f"{pname}_count {desc['count']:g}")
             lines.append(f"{pname}_sum {desc['sum']:g}")
             for k in ("min", "max"):
                 if desc[k] is not None:
                     lines.append(f"{pname}_{k} {desc[k]:g}")
+    return lines
+
+
+def export_prom(path: Optional[str] = None) -> Optional[str]:
+    """Write :func:`prom_lines` in Prometheus textfile-collector format
+    to ``path`` (default ``BLUEFOG_METRICS_PROM``), atomically (write to
+    ``<path>.tmp`` then rename — node_exporter may scrape mid-write)."""
+    path = path or os.environ.get("BLUEFOG_METRICS_PROM")
+    if not path:
+        return None
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write("\n".join(prom_lines()) + "\n")
     os.replace(tmp, path)
     return path
 

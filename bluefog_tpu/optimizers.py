@@ -58,6 +58,7 @@ import optax
 from bluefog_tpu import attribution
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
+from bluefog_tpu import health as health_mod
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
@@ -1111,6 +1112,11 @@ class _GossipOptimizer:
                     if doc_t0 is not None else None
                 ),
             )
+            # fleet health plane (BLUEFOG_HEALTH): same discipline —
+            # host arithmetic + its own tiny lane dispatches only
+            health_mod.observe_step(
+                ctx, step=self._step_count - 1, plan=self._last_plan,
+            )
         if ef:
             self._ef = ef_out
         if met:
@@ -1460,6 +1466,11 @@ class _GossipOptimizer:
                         time.perf_counter() - doc_t0
                         if doc_t0 is not None else None
                     ),
+                )
+                # fleet health plane: same host-side-only discipline
+                health_mod.observe_step(
+                    ctx, step=self._step_count - 1,
+                    plan=self._last_plan,
                 )
             if has_aux:
                 return params_o, state_o, (loss, aux)

@@ -20,12 +20,17 @@ from bluefog_tpu.topology.graphs import (
     GetRecvWeights,
     GetSendWeights,
     isPowerOf,
+    mixing_matrix,
+    second_largest_eigenvalue_modulus,
+    spectral_gap,
+    consensus_decay_rate,
 )
 from bluefog_tpu.topology.dynamic import (
     GetDynamicOnePeerSendRecvRanks,
     GetExp2DynamicSendRecvMachineRanks,
     GetInnerOuterRingDynamicSendRecvRanks,
     GetInnerOuterExpo2DynamicSendRecvRanks,
+    one_peer_period_matrices,
 )
 from bluefog_tpu.topology.infer import (
     InferSourceFromDestinationRanks,
@@ -55,10 +60,15 @@ __all__ = [
     "GetRecvWeights",
     "GetSendWeights",
     "isPowerOf",
+    "mixing_matrix",
+    "second_largest_eigenvalue_modulus",
+    "spectral_gap",
+    "consensus_decay_rate",
     "GetDynamicOnePeerSendRecvRanks",
     "GetExp2DynamicSendRecvMachineRanks",
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
+    "one_peer_period_matrices",
     "InferSourceFromDestinationRanks",
     "InferDestinationFromSourceRanks",
     "serpentine_device_order",

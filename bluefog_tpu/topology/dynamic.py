@@ -25,6 +25,7 @@ __all__ = [
     "GetExp2DynamicSendRecvMachineRanks",
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
+    "one_peer_period_matrices",
 ]
 
 
@@ -61,6 +62,45 @@ def GetDynamicOnePeerSendRecvRanks(
         ]
         yield [send_rank], recv_ranks
         index += 1
+
+
+def one_peer_period_matrices(
+    topo: nx.DiGraph, period: int = None
+) -> List[np.ndarray]:
+    """Per-iteration mixing matrices of the one-peer schedule over one
+    full period — the spectral-analysis view of
+    :func:`GetDynamicOnePeerSendRecvRanks`.
+
+    Weight policy matches the compiled lowering
+    (:func:`bluefog_tpu.collective.plan.schedule_from_dynamic`,
+    ``uniform=True``): at each iteration rank ``j`` averages itself and
+    its receive set with ``1 / (len(recv) + 1)``. The period defaults to
+    the lcm of the per-rank out-degrees (each rank cycles its own
+    neighbor list). Feed the result to
+    :func:`bluefog_tpu.topology.consensus_decay_rate` for the
+    period-product predicted decay — a single iteration's matrix is
+    rank-deficient in mixing terms (one peer per rank) and only the
+    product contracts like the schedule actually does."""
+    import math
+
+    size = topo.number_of_nodes()
+    if period is None:
+        period = 1
+        for r in range(size):
+            deg = max(len(_sorted_out_neighbors(topo, r)), 1)
+            period = period * deg // math.gcd(period, deg)
+    iters = [GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
+    mats: List[np.ndarray] = []
+    for _ in range(period):
+        step = [next(it) for it in iters]
+        w = np.zeros((size, size))
+        for j, (_send, recv) in enumerate(step):
+            wt = 1.0 / (len(recv) + 1)
+            w[j, j] = wt
+            for i in recv:
+                w[i, j] = wt
+        mats.append(w)
+    return mats
 
 
 def GetExp2DynamicSendRecvMachineRanks(

@@ -33,6 +33,10 @@ __all__ = [
     "GetRecvWeights",
     "GetSendWeights",
     "isPowerOf",
+    "mixing_matrix",
+    "second_largest_eigenvalue_modulus",
+    "spectral_gap",
+    "consensus_decay_rate",
 ]
 
 
@@ -254,6 +258,70 @@ def RandomRegularDigraph(size: int, degree: int, seed: int = 0) -> nx.DiGraph:
     for i in range(size):
         mat[i, i] = uniform
     return nx.from_numpy_array(mat, create_using=nx.DiGraph)
+
+
+# -- spectral analysis (the mixing observatory's predicted-rate core) ---------
+
+
+def mixing_matrix(topo: nx.DiGraph) -> np.ndarray:
+    """The combination matrix ``W`` of a topology as a dense array
+    (``W[i, j]`` = weight rank ``j`` applies to rank ``i``'s value — the
+    convention every generator above produces). One gossip step maps the
+    stacked iterate ``x`` to ``W^T x``."""
+    return nx.to_numpy_array(topo)
+
+
+def second_largest_eigenvalue_modulus(w: np.ndarray) -> float:
+    """SLEM of a stochastic combine matrix: the modulus of the largest
+    eigenvalue once one Perron root (the eigenvalue nearest 1) is
+    removed.
+
+    For a doubly stochastic ``W`` the consensus error ``x - x̄``
+    contracts per gossip step by exactly this factor asymptotically —
+    the paper's convergence premise. A disconnected (or periodic)
+    matrix reports SLEM 1.0: no contraction is promised, and the
+    observatory treats the prediction as "none". Eigenvalues of ``W``
+    and ``W^T`` coincide, so either orientation convention gives the
+    same answer."""
+    w = np.asarray(w, np.float64)
+    if w.shape[0] <= 1:
+        return 0.0
+    eig = np.linalg.eigvals(w)
+    # drop ONE root closest to 1 (the Perron eigenvalue); ties beyond it
+    # (disconnected/periodic chains) stay and correctly report 1.0
+    drop = int(np.argmin(np.abs(eig - 1.0)))
+    rest = np.delete(eig, drop)
+    return float(np.max(np.abs(rest))) if rest.size else 0.0
+
+
+def spectral_gap(w: np.ndarray) -> float:
+    """``1 - SLEM``: the per-step consensus contraction margin the
+    matrix promises (0 = no mixing guarantee, 1 = one-step consensus,
+    e.g. fully connected uniform weights)."""
+    return 1.0 - second_largest_eigenvalue_modulus(w)
+
+
+def consensus_decay_rate(mats) -> float:
+    """Predicted per-step consensus decay rate for one matrix or a
+    periodic sequence of matrices (dynamic one-peer schedules, the
+    elastic engine's per-period repaired plans).
+
+    A single matrix returns its SLEM. A sequence returns
+    ``SLEM(W_K^T ... W_1^T)^(1/K)`` — the period-product contraction
+    normalized back to one step, the quantity comparable against a
+    per-step measured decay series."""
+    if isinstance(mats, np.ndarray) and mats.ndim == 2:
+        mats = [mats]
+    mats = [np.asarray(m, np.float64) for m in mats]
+    if not mats:
+        return 1.0
+    prod = np.eye(mats[0].shape[0])
+    for m in mats:
+        # one gossip step is x -> W^T x, so the period product composes
+        # transposes in application order
+        prod = m.T @ prod
+    rho = second_largest_eigenvalue_modulus(prod)
+    return float(rho ** (1.0 / len(mats)))
 
 
 def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:

@@ -554,6 +554,98 @@ def test_quant_evidence_file_committed():
     assert anchor and anchor[0]["tflops"] > 0
 
 
+def test_health_evidence_file_committed():
+    """HEALTH_EVIDENCE.json (the committed BENCH_MODE=health output)
+    carries the acceptance facts: measured consensus decay within the
+    disclosed tolerance of the spectral prediction on ring AND Exp2
+    with the Exp2-mixes-faster ordering, sampled-health overhead <=1%
+    with the A/A control and the structural + bitwise pins, the
+    push-sum lane matching its numpy oracle under a dead rank, and the
+    chaos scenario where ``mixing_degraded`` names the injected edge —
+    plus provenance and the ambient anchor."""
+    path = os.path.join(REPO, "HEALTH_EVIDENCE.json")
+    assert os.path.exists(path), "HEALTH_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    decay = {
+        l["topology"]: l for l in lines
+        if l.get("metric") == "health_decay"
+    }
+    assert set(decay) == {"ring", "exp2"}, sorted(decay)
+    for name, l in decay.items():
+        assert l["within_tolerance"] is True, l
+        assert 0 < l["predicted_rate"] < 1
+        assert 0 < l["measured_rate"] < 1
+        assert l["tolerance"] <= 0.2  # the disclosed bound stays tight
+        assert l["time_to_eps_steps"] > 0
+    order = [
+        l for l in lines if l.get("metric") == "health_decay_ordering"
+    ]
+    assert order and order[0]["exp2_mixes_faster_than_ring"] is True
+    fleet = [l for l in lines if l.get("metric") == "health_fleet"]
+    assert fleet, lines
+    assert fleet[0]["lane_vs_oracle_max_err"] < 1e-3
+    assert fleet[0]["minmax_exact_over_live"] is True
+    assert fleet[0]["mean_rel_err_vs_true"] < 0.05
+    assert fleet[0]["dead_ranks"], "oracle must cover a dead rank"
+    overhead = [
+        l for l in lines if l.get("metric") == "health_overhead"
+    ]
+    assert overhead, lines
+    assert overhead[0]["overhead_pct"] <= 1.0
+    assert "control_aa_pct" in overhead[0]
+    assert overhead[0]["unsampled_program_shared"] is True
+    assert overhead[0]["bitwise_identical"] is True
+    mix = [
+        l for l in lines
+        if l.get("metric") == "health_mixing_degraded"
+    ]
+    assert mix and mix[0]["named_correctly"] is True
+    assert mix[0]["injected_edge"] in mix[0]["edges_named"]
+    assert mix[0]["degraded_efficiency"] < mix[0]["healthy_efficiency"]
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_health_columns_are_tooling_gained(tmp_path):
+    """The health evidence adds mixing-observatory columns
+    (predicted/measured rate, efficiency) to cells; against a
+    pre-health artifact their one-sided appearance must read as
+    tooling-gained-a-column, never a timing-harness break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_health_cols):
+        row = {
+            "metric": "gossip_step", "n_workers": 8,
+            "ms_per_step": 10.0, "median": 10.1, "min": 9.9,
+        }
+        if with_health_cols:
+            row["predicted_rate"] = 0.5
+            row["measured_rate"] = 0.51
+            row["mixing_efficiency"] = 0.97
+        path.write_text(
+            json.dumps(prov) + "\n" + json.dumps(row) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
 def test_bench_diff_wire_columns_are_tooling_gained(tmp_path):
     """The quantized-wire evidence adds wire-byte accounting columns to
     existing cells; against a pre-quant artifact their one-sided

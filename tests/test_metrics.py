@@ -85,6 +85,101 @@ def test_prom_export_sanitizes_and_types(tmp_path):
         assert " " in line and not line.startswith("."), line
 
 
+def test_histogram_log_bucket_quantiles():
+    """Bounded log-bucket tail quantiles: p50/p90/p99 within the
+    documented ~9% relative error on a known distribution, bounded
+    bucket count on a hostile range, zero handling, describe() and
+    exporter surfacing."""
+    h = metrics.Histogram()
+    for v in range(1, 1001):  # uniform 1..1000: p50=500, p90=900
+        h.observe(float(v))
+    assert h.quantile(0.5) == pytest.approx(500, rel=0.1)
+    assert h.quantile(0.9) == pytest.approx(900, rel=0.1)
+    assert h.quantile(0.99) == pytest.approx(990, rel=0.1)
+    desc = h.describe()
+    assert desc["p50"] == h.quantile(0.5)
+    assert desc["p99"] <= desc["max"]
+    # quantiles never escape the observed envelope
+    one = metrics.Histogram()
+    one.observe(7.3)
+    assert one.quantile(0.5) == 7.3 and one.quantile(0.99) == 7.3
+    # bounded storage on a hostile range; zeros share the underflow
+    # bucket and report at the floor, not a crash
+    wild = metrics.Histogram()
+    for v in (0.0, -5.0, 1e-30, 1e30, 3.0):
+        wild.observe(v)
+    assert len(wild._buckets) <= 321
+    assert wild.quantile(0.5) is not None
+    empty = metrics.Histogram()
+    assert empty.quantile(0.5) is None
+    assert "p50" not in empty.describe()
+
+
+def test_prom_export_deterministic_with_help_and_quantiles(tmp_path):
+    """Satellite: successive scrapes of an unchanged registry are
+    byte-identical (deterministic series ordering) and every family
+    carries # HELP/# TYPE; histogram quantiles export as
+    {quantile=...} series."""
+    metrics.gauge("bluefog.z_last").set(1)
+    metrics.counter("bluefog.a_first").inc()
+    for v in (1.0, 2.0, 4.0):
+        metrics.histogram("bluefog.lat").observe(v)
+    p1 = str(tmp_path / "a.prom")
+    p2 = str(tmp_path / "b.prom")
+    metrics.export_prom(p1)
+    metrics.export_prom(p2)
+    t1, t2 = open(p1).read(), open(p2).read()
+    assert t1 == t2  # diffs cleanly scrape to scrape
+    lines = t1.splitlines()
+    # sorted by raw name: a_first family renders before lat before z_last
+    first_of = {
+        name: next(
+            i for i, l in enumerate(lines) if name in l
+        )
+        for name in ("bluefog_a_first", "bluefog_lat", "bluefog_z_last")
+    }
+    assert first_of["bluefog_a_first"] < first_of["bluefog_lat"] < (
+        first_of["bluefog_z_last"]
+    )
+    for pname, ptype in (
+        ("bluefog_a_first_total", "counter"),
+        ("bluefog_z_last", "gauge"),
+        ("bluefog_lat", "summary"),
+    ):
+        assert f"# HELP {pname} " in t1
+        assert f"# TYPE {pname} {ptype}" in t1
+    assert 'bluefog_lat{quantile="0.5"}' in t1
+    assert 'bluefog_lat{quantile="0.99"}' in t1
+
+
+def test_metrics_report_surfaces_histogram_quantiles(tmp_path):
+    """tools/metrics_report.py renders p50/p90/p99 as synthetic series
+    rows, so a JSONL digest can state tail latency."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    row = {"ts": 1.0, "metrics": {
+        "bluefog.lat": {"type": "histogram", "count": 3, "sum": 7.0,
+                        "min": 1.0, "max": 4.0, "last": 4.0,
+                        "p50": 2.0, "p90": 4.0, "p99": 4.0},
+    }}
+    path = tmp_path / "run.jsonl"
+    path.write_text(json.dumps(row) + "\n")
+    env = dict(os.environ, PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "metrics_report.py"),
+         str(path), "--json"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout)
+    assert report["series"]["bluefog.lat.p50"]["last"] == 2.0
+    assert report["series"]["bluefog.lat.p99"]["last"] == 4.0
+
+
 # -- satellite: unknown log level warns once ----------------------------------
 
 

@@ -78,8 +78,18 @@ WIRE_DERIVED = {
     "wire_reduction_int4_vs_int8",
 }
 
+# Mixing-observatory columns that arrived with the fleet health plane
+# (BENCH_MODE=health): spectral predictions and fitted decay rates are
+# derived analysis, not timed measurements, so a one-sided appearance
+# against a pre-health artifact is the tooling gaining a column —
+# never a timing-harness change.
+HEALTH_DERIVED = {
+    "predicted_rate", "measured_rate", "mixing_efficiency",
+    "rate_ratio", "time_to_eps_steps", "fleet_residual",
+}
+
 # Every one-sided-tolerated derived column set.
-TOOLING_DERIVED = ANCHOR_DERIVED | WIRE_DERIVED
+TOOLING_DERIVED = ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
 

@@ -16,7 +16,7 @@ Usage::
 
     python tools/doctor.py --attribution doctor_dump.json \
         [--metrics run.jsonl] [--flight flight_dir_or_files...] \
-        [--json] [--out report.json]
+        [--health health.json] [--json] [--out report.json]
 
 The report contains:
 
@@ -31,6 +31,11 @@ The report contains:
   crash trigger corroborates what the doctor saw live);
 - ``metrics`` — last-known doctor gauges and gossip-health series from
   the metrics JSONL;
+- ``health`` — the fleet health plane's view (``--health``: a
+  ``bf.health.dump()`` artifact or a ``tools/fleet_report.py --json``
+  rollup, docs/health.md): mixing efficiency vs the spectral
+  prediction, and the worst rank in the in-band fleet aggregate with
+  its dominant advisory, named in the human-sentence section;
 - ``summary`` — the human sentences, most damning first.
 """
 
@@ -40,6 +45,11 @@ import json
 import os
 import sys
 from typing import Dict, List, Optional
+
+try:  # package context (tests import tools.doctor)
+    from tools import fleet_report as fleet_report_mod
+except ImportError:  # script context: tools/ itself is sys.path[0]
+    import fleet_report as fleet_report_mod
 
 
 def _median(vals):
@@ -91,6 +101,65 @@ def load_flight_dumps(paths: List[str]) -> List[dict]:
         except (OSError, ValueError):
             continue
     return dumps
+
+
+def load_health(path: str) -> dict:
+    """A health artifact (``bf.health.dump()``) or a fleet rollup
+    (``tools/fleet_report.py --json``)."""
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("kind") not in ("health_dump", "fleet_report"):
+        raise ValueError(
+            f"{path} is not a health artifact (expected kind "
+            f"'health_dump' or 'fleet_report', got {d.get('kind')!r})"
+        )
+    return d
+
+
+def health_section(health: Optional[dict]) -> Optional[dict]:
+    """Fold the health artifact/rollup into the triage report: mixing
+    observatory state, worst rank, dominant advisory. The worst-rank
+    judgment is tools/fleet_report.py's — a rollup carries it
+    precomputed, and a raw artifact goes through the same helper, so
+    the two tools can never name different ranks from one artifact."""
+    if health is None:
+        return None
+    fleet = health.get("fleet")
+    if health.get("kind") == "fleet_report":
+        advisories = []
+        overall = health.get("overall")
+        worst = health.get("worst_rank")
+        rows = [
+            r for r in health.get("processes", [])
+            if not r.get("unreadable")
+        ]
+        # the observatory fields live on the per-process rows; take the
+        # most-advanced process, like the rollup's own fleet block
+        last = max(
+            rows, key=lambda r: r.get("comm_steps") or 0, default={},
+        )
+        doms = [
+            r.get("dominant_advisory") for r in rows
+            if r.get("dominant_advisory")
+        ]
+    else:
+        advisories = health.get("advisories") or []
+        last = health.get("last_sample") or {}
+        overall = (health.get("healthz") or {}).get("status")
+        worst = fleet_report_mod.worst_rank(fleet)
+        dom = fleet_report_mod.dominant_advisory(advisories)
+        doms = [dom] if dom else []
+    return {
+        "overall": overall,
+        "mixing_efficiency": last.get("mixing_efficiency"),
+        "predicted_rate": last.get("predicted_rate"),
+        "measured_rate": last.get("measured_rate"),
+        "time_to_eps_steps": last.get("time_to_eps_steps"),
+        "advisories": advisories[-8:],
+        "worst_rank": worst,
+        "dominant_advisory": doms[0] if doms else None,
+        "fleet_residual": (fleet or {}).get("residual"),
+    }
 
 
 def step_time_trend(samples: List[dict], window: int = 4) -> Optional[dict]:
@@ -157,9 +226,11 @@ def suspect_rounds(samples: List[dict], ratio: float = 3.0) -> List[dict]:
 
 
 def triage(attribution: dict, metrics_rows: List[dict],
-           flight_dumps: List[dict]) -> dict:
+           flight_dumps: List[dict],
+           health: Optional[dict] = None) -> dict:
     samples = attribution.get("samples", [])
     advisories = list(attribution.get("advisories", []))
+    health_view = health_section(health)
 
     flight_advisories = []
     dump_reasons = []
@@ -226,6 +297,32 @@ def triage(attribution: dict, metrics_rows: List[dict],
                 f"{r['predicted_ms']} ms predicted "
                 f"({r['residual_ratio']}x over the model)"
             )
+    if health_view:
+        worst = health_view.get("worst_rank")
+        if worst is not None:
+            sentence = (
+                f"rank {worst['rank']} is the worst in the fleet "
+                f"(consensus {worst['consensus']:.4g}"
+            )
+            if worst.get("vs_fleet_mean"):
+                sentence += (
+                    f", {worst['vs_fleet_mean']}x the fleet mean"
+                )
+            sentence += ")"
+            dom = health_view.get("dominant_advisory")
+            if dom:
+                sentence += f"; dominant advisory: {dom}"
+            summary.append(sentence)
+        eff = health_view.get("mixing_efficiency")
+        if eff is not None and eff < 0.9 and health_view.get(
+            "predicted_rate"
+        ) is not None:
+            summary.append(
+                f"mixing delivers {eff:.0%} of the spectral promise "
+                f"(predicted per-step rate "
+                f"{health_view['predicted_rate']:.4g}, measured "
+                f"{health_view.get('measured_rate')})"
+            )
     for a in advisories[-5:]:
         detail = {
             k: v for k, v in a.items() if k not in ("kind", "step")
@@ -256,6 +353,7 @@ def triage(attribution: dict, metrics_rows: List[dict],
         "flight_dump_reasons": dump_reasons,
         "doctor_metrics": doctor_series,
         "gossip_metrics": gossip_series,
+        "health": health_view,
         "summary": summary,
     }
 
@@ -268,6 +366,9 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", help="BLUEFOG_METRICS_FILE JSONL")
     ap.add_argument("--flight", nargs="*", default=[],
                     help="flight dump files or directories")
+    ap.add_argument("--health",
+                    help="health artifact (bf.health.dump) or "
+                         "tools/fleet_report.py --json rollup")
     ap.add_argument("--json", action="store_true",
                     help="print the full JSON report")
     ap.add_argument("--out", help="also write the JSON report here")
@@ -278,7 +379,8 @@ def main(argv=None) -> int:
         load_metrics_jsonl(args.metrics) if args.metrics else []
     )
     flight_dumps = load_flight_dumps(args.flight)
-    report = triage(attribution, metrics_rows, flight_dumps)
+    health = load_health(args.health) if args.health else None
+    report = triage(attribution, metrics_rows, flight_dumps, health)
 
     if args.out:
         with open(args.out, "w") as f:

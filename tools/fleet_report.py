@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Render one fleet table from N ranks' health artifacts or endpoints.
+
+The fleet health plane (:mod:`bluefog_tpu.health`, docs/health.md)
+leaves one artifact per controller process — ``bf.health.dump(path)``
+JSON, or the live ``/fleet`` endpoint under ``BLUEFOG_HEALTH_PORT`` —
+each carrying that process's local summary, its in-band push-sum view
+of the whole fleet, and its ``/healthz`` verdict. This tool joins N of
+them into the single table an operator reads first: per process the
+RAG status, step time, consensus, mixing efficiency; then the fleet
+min/mean/max block and the **worst rank** with its dominant advisory.
+
+Usage::
+
+    python tools/fleet_report.py health_0.json health_1.json ...
+    python tools/fleet_report.py --endpoints localhost:8787,host2:8787
+    python tools/fleet_report.py ... --json
+
+No jax import, no live mesh needed for artifact mode. Exit status 0 on
+a parseable input set (even empty), 2 when nothing could be read.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+FIELD_CONSENSUS = 1  # index of "consensus" in health.FLEET_FIELDS
+
+
+def fetch_endpoint(hostport: str, timeout: float = 5.0) -> dict:
+    """GET ``/fleet`` from one rank's health endpoint."""
+    import urllib.request
+
+    url = f"http://{hostport.strip()}/fleet"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("kind") != "health_dump":
+        raise ValueError(
+            f"{path} is not a health artifact (expected kind="
+            f"'health_dump', got {d.get('kind')!r})"
+        )
+    return d
+
+
+def worst_rank(fleet: Optional[dict]) -> Optional[dict]:
+    """The rank a fleet operator pages on: the live rank whose
+    push-sum-estimated consensus distance sits furthest above the
+    fleet mean (ties break toward higher step time)."""
+    if not fleet or not fleet.get("per_rank_mean"):
+        return None
+    fields = fleet.get("fields") or []
+    ci = (
+        fields.index("consensus") if "consensus" in fields
+        else FIELD_CONSENSUS
+    )
+    mean = fleet.get("mean") or []
+    fleet_mean = mean[ci] if len(mean) > ci else 0.0
+    best: Optional[Tuple[float, float, int]] = None
+    for rank, vec in fleet["per_rank_mean"].items():
+        if len(vec) <= ci:
+            continue
+        key = (float(vec[ci]), float(vec[0]) if vec else 0.0, -int(rank))
+        if best is None or key > best:
+            best = key
+    if best is None:
+        return None
+    value, step_ms, neg_rank = best
+    return {
+        "rank": -int(neg_rank),
+        "consensus": value,
+        "vs_fleet_mean": (
+            round(value / fleet_mean, 2) if fleet_mean else None
+        ),
+        "step_ms": step_ms,
+    }
+
+
+def dominant_advisory(advisories: List[dict]) -> Optional[str]:
+    counts: dict = {}
+    for a in advisories or []:
+        k = a.get("kind", a.get("advisory_kind", "?"))
+        counts[k] = counts.get(k, 0) + 1
+    if not counts:
+        return None
+    return max(sorted(counts), key=lambda k: counts[k])
+
+
+def build_report(dumps: List[dict], sources: List[str]) -> dict:
+    rows = []
+    fleet = None
+    for src, d in zip(sources, dumps):
+        if d.get("unreadable"):
+            rows.append({"source": src, "unreadable": True})
+            continue
+        last = d.get("last_sample") or {}
+        hz = d.get("healthz") or {}
+        rows.append({
+            "source": src,
+            "status": hz.get("status", "?"),
+            "comm_steps": d.get("comm_steps"),
+            "step_ms_ewma": last.get("step_ms_ewma"),
+            "consensus": last.get("consensus"),
+            "mixing_efficiency": last.get("mixing_efficiency"),
+            "predicted_rate": last.get("predicted_rate"),
+            "measured_rate": last.get("measured_rate"),
+            "time_to_eps_steps": last.get("time_to_eps_steps"),
+            "advisories": len(d.get("advisories") or []),
+            "dominant_advisory": dominant_advisory(
+                d.get("advisories") or []
+            ),
+        })
+        # any rank's in-band view serves as the fleet block (they agree
+        # to within the disclosed push-sum residual); keep the one with
+        # the most samples behind it
+        if d.get("fleet") and (
+            fleet is None
+            or (d.get("comm_steps") or 0) > (fleet[0] or 0)
+        ):
+            fleet = (d.get("comm_steps"), d["fleet"])
+    fleet_block = fleet[1] if fleet else None
+    worst = worst_rank(fleet_block)
+    statuses = [r.get("status") for r in rows if not r.get("unreadable")]
+    overall = (
+        "critical" if "critical" in statuses
+        else "warn" if "warn" in statuses
+        else "ok" if statuses else "unknown"
+    )
+    return {
+        "kind": "fleet_report",
+        "overall": overall,
+        "processes": rows,
+        "fleet": fleet_block,
+        "worst_rank": worst,
+        "unreadable": sum(1 for r in rows if r.get("unreadable")),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="health artifact JSON files (bf.health.dump "
+                         "output / saved /fleet responses)")
+    ap.add_argument("--endpoints",
+                    help="comma-separated host:port list to scrape "
+                         "live /fleet from")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    dumps: List[dict] = []
+    sources: List[str] = []
+    for p in args.artifacts:
+        sources.append(p)
+        try:
+            dumps.append(load_artifact(p))
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+            dumps.append({"unreadable": True})
+    for hp in (args.endpoints or "").split(","):
+        hp = hp.strip()
+        if not hp:
+            continue
+        sources.append(hp)
+        try:
+            dumps.append(fetch_endpoint(hp))
+        except Exception as e:
+            print(f"warning: {hp}: {e}", file=sys.stderr)
+            dumps.append({"unreadable": True})
+    if not dumps:
+        print("no artifacts or endpoints given", file=sys.stderr)
+        return 2
+    if all(d.get("unreadable") for d in dumps):
+        print("error: no readable input", file=sys.stderr)
+        return 2
+
+    report = build_report(dumps, sources)
+    if args.json:
+        print(json.dumps(report))
+        return 0
+
+    print(f"fleet: {report['overall']} "
+          f"({len(report['processes'])} process(es)"
+          + (f", {report['unreadable']} unreadable" if
+             report["unreadable"] else "") + ")")
+    cols = ("source", "status", "step_ms_ewma", "consensus",
+            "mixing_efficiency", "advisories", "dominant_advisory")
+    for r in report["processes"]:
+        if r.get("unreadable"):
+            print(f"  {r['source']}: unreadable")
+            continue
+        print("  " + "  ".join(
+            f"{c}={r.get(c)}" for c in cols if r.get(c) is not None
+        ))
+    fleet = report.get("fleet")
+    if fleet:
+        fields = fleet.get("fields") or []
+        warming = " — min/max WARMING (first generation incomplete)" \
+            if fleet.get("warming") else ""
+        print(f"fleet aggregate (live={fleet.get('live')}, "
+              f"push-sum residual {fleet.get('residual'):.2e}"
+              f"{warming}):")
+        for i, name in enumerate(fields):
+            print(f"  {name:<20} min {fleet['min'][i]:>12.6g}  "
+                  f"mean {fleet['mean'][i]:>12.6g}  "
+                  f"max {fleet['max'][i]:>12.6g}")
+    worst = report.get("worst_rank")
+    if worst:
+        sentence = (
+            f"worst rank: {worst['rank']} (consensus "
+            f"{worst['consensus']:.4g}"
+        )
+        if worst.get("vs_fleet_mean"):
+            sentence += f", {worst['vs_fleet_mean']}x the fleet mean"
+        sentence += ")"
+        doms = [
+            r.get("dominant_advisory") for r in report["processes"]
+            if r.get("dominant_advisory")
+        ]
+        if doms:
+            sentence += f"; dominant advisory: {doms[0]}"
+        print(sentence)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

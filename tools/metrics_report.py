@@ -54,18 +54,26 @@ def summarize(lines):
             skipped += 1
             continue
         for name, desc in metrics.items():
-            v = _series_value(desc)
-            if v is None:
-                continue
-            cur = series.setdefault(
-                name,
-                {"min": v, "max": v, "last": v, "samples": 0,
-                 "type": desc.get("type", "?")},
-            )
-            cur["min"] = min(cur["min"], v)
-            cur["max"] = max(cur["max"], v)
-            cur["last"] = v
-            cur["samples"] += 1
+            folds = [(name, _series_value(desc))]
+            if desc.get("type") == "histogram":
+                # log-bucket tail quantiles ride as synthetic series so
+                # the table answers "what was p99" without a dashboard
+                folds += [
+                    (f"{name}.{q}", desc.get(q))
+                    for q in ("p50", "p90", "p99")
+                ]
+            for fname, v in folds:
+                if v is None:
+                    continue
+                cur = series.setdefault(
+                    fname,
+                    {"min": v, "max": v, "last": v, "samples": 0,
+                     "type": desc.get("type", "?")},
+                )
+                cur["min"] = min(cur["min"], v)
+                cur["max"] = max(cur["max"], v)
+                cur["last"] = v
+                cur["samples"] += 1
     stalls = series.get("bluefog.stalls", {}).get("last", 0)
     return {
         "snapshots": len(lines) - skipped,
