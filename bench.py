@@ -63,6 +63,17 @@ Individual families via ``BENCH_MODE``:
   bitwise pins), and a deterministic lossy-link chaos scenario whose
   ``mixing_degraded`` advisory must name the injected edge. Committed
   as HEALTH_EVIDENCE.json.
+- ``staleness``: staleness-observatory evidence (``bf.staleness``,
+  docs/staleness.md) — the lineage lane's synchronous-path age ≡ 0
+  self-check with the sidecar priced by
+  ``scaling.wire_payload_bytes``, the ``delayed=True`` steady-state
+  age ≡ 1 invariant with the topology-swap age-0 transition, the
+  age-discounted mixing correction measurably shrinking the health
+  plane's predicted-vs-measured residual on a delayed run, the <=1 %
+  overhead bound at the default sampling interval (A/A control,
+  structural + bitwise pins), and a deterministic per-edge stall chaos
+  scenario whose measured age spike and ``staleness_breach`` advisory
+  must name the injected edge. Committed as STALENESS_EVIDENCE.json.
 - ``quant``: quantized-wire evidence — every wire tier
   (fp32/bf16/int8/int8_ef/int4/int4_ef) on one pure-consensus problem,
   per-tier wire bytes with the block-scale sidecar priced in,
@@ -2604,6 +2615,441 @@ def run_health() -> int:
     return 0
 
 
+def run_staleness() -> int:
+    """Staleness-observatory evidence (``BENCH_MODE=staleness``,
+    committed as STALENESS_EVIDENCE.json). Five claims, each measured
+    the way it is resolvable (the metrics/health noise-floor lessons
+    apply):
+
+    1. **Sync age ≡ 0 (lane self-check)**: the two-program optimizer
+       gossips the fresh iterate; every sampled per-edge delivered age
+       must be exactly 0 with the lane's own provenance check green —
+       plus the sidecar-accounting pin (``scaling.wire_payload_bytes``
+       with ``lineage=True`` prices exactly LINEAGE_TAG_BYTES more).
+    2. **Delayed age ≡ 1 + transition**: the fused ``delayed=True``
+       path measures age 0 on the reseed step, 1 in steady state, and
+       an observable age-0 transition at a mid-run topology swap.
+    3. **Age-discounted mixing shrinks the health residual**: on a
+       pure-consensus ``delayed=True`` run the raw efficiency reads
+       ~0.6-0.7 (the zero-staleness SLEM overstates the promise); the
+       stale-mixing companion-polynomial correction must land the
+       adjusted efficiency strictly closer to 1.0.
+    4. **Overhead <= 1 % at the default interval**: sampled-step extra
+       cost measured by an all-orderings off/on/off rotation,
+       amortized over the default interval, A/A control disclosed;
+       structural pin (no new train-step cache entries; the lane lives
+       under ``staleness_lane`` keys) and bitwise on/off trajectory
+       pin.
+    5. **Per-edge stall chaos**: an injected ``stall`` with
+       ``steps=``/``peer=`` must produce exactly the expected measured
+       age ramp on the injected edge (and ONLY that edge), and the
+       ``staleness_breach`` advisory must name it.
+    """
+    from bluefog_tpu.platforms import ensure_cpu_device_count
+
+    ensure_cpu_device_count(
+        int(os.environ.get("BENCH_STALENESS_DEVICES", "8"))
+    )
+    import itertools
+    import time as time_mod
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import health, scaling, staleness
+    from bluefog_tpu import metrics as bf_metrics
+
+    devices = jax.devices()
+    n = min(len(devices),
+            int(os.environ.get("BENCH_STALENESS_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_STALENESS_DIM", "256"))
+    layers = int(os.environ.get("BENCH_STALENESS_LAYERS", "6"))
+    batch = int(os.environ.get("BENCH_STALENESS_BATCH", "16"))
+    samples = max(18, int(os.environ.get("BENCH_STALENESS_SAMPLES",
+                                         "60")))
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_STALENESS", "BLUEFOG_STALENESS_INTERVAL",
+                  "BLUEFOG_STALENESS_BOUND", "BLUEFOG_STALENESS_FILE",
+                  "BLUEFOG_METRICS", "BLUEFOG_HEALTH", "BLUEFOG_DOCTOR")
+    }
+    for k in old_env:
+        os.environ.pop(k, None)
+    default_interval = staleness.staleness_interval()
+
+    bf.init(devices=devices[:n])
+    ctx = bf.get_context()
+    rng = np.random.RandomState(0)
+
+    # -- claim 1: synchronous path age ≡ 0, sidecar priced --------------------
+    bf.set_topology(topo.RingGraph(n))
+    obs = staleness.start(interval=1)
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.01))
+    params = {"w": bf.worker_values(
+        lambda r: rng.randn(4096).astype(np.float32)
+    )}
+    state = opt.init(params)
+    grads = {"w": bf.worker_values(
+        lambda r: np.zeros(4096, np.float32)
+    )}
+    sync_steps = 12
+    for _ in range(sync_steps):
+        params, state = opt.step(params, state, grads)
+    sync_samples = list(obs.samples)
+    ages_all_zero = all(
+        s["age_max"] == 0.0 and s["lane_ok"] for s in sync_samples
+    )
+    sidecar_delta = (
+        scaling.wire_payload_bytes(4096, 4, None, lineage=True)
+        - scaling.wire_payload_bytes(4096, 4, None)
+    )
+    lane_bytes = bf_metrics.peek("bluefog.staleness.wire_bytes")
+    print(json.dumps({
+        "metric": "staleness_sync",
+        "n_workers": n,
+        "steps": sync_steps,
+        "edges_per_sample": sync_samples[0]["edges"],
+        "ages_all_zero": ages_all_zero,
+        "lane_selfcheck_ok": all(s["lane_ok"] for s in sync_samples),
+        "lineage_tag_bytes": scaling.LINEAGE_TAG_BYTES,
+        "sidecar_delta_bytes": sidecar_delta,
+        "sidecar_priced_in_wire_payload_bytes": (
+            sidecar_delta == scaling.LINEAGE_TAG_BYTES
+        ),
+        "lane_wire_bytes_total": (
+            lane_bytes.value if lane_bytes is not None else 0
+        ),
+    }))
+    staleness.stop()
+
+    # -- claim 2: delayed ≡ 1 steady state + swap transition ------------------
+    def consensus_loss(p, x):
+        return ((p["w"] - x) ** 2).mean()
+
+    opt_d = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.0))
+    ts = opt_d.make_train_step(consensus_loss, delayed=True)
+    p_d = {"w": bf.worker_values(
+        lambda r: np.random.RandomState(r).randn(2048)
+        .astype(np.float32)
+    )}
+    s_d = opt_d.init(p_d)
+    x_d = bf.worker_values(lambda r: np.zeros(2048, np.float32))
+    obs = staleness.start(interval=1)
+    pre_swap = 8
+    for _ in range(pre_swap):
+        p_d, s_d, _loss = ts(p_d, s_d, x_d)
+    bf.set_topology(topo.ExponentialTwoGraph(n))
+    for _ in range(6):
+        p_d, s_d, _loss = ts(p_d, s_d, x_d)
+    age_seq = [s["age_mean"] for s in obs.samples]
+    steady_pre = age_seq[1:pre_swap]
+    post = age_seq[pre_swap:]
+    delayed_line = {
+        "metric": "staleness_delayed",
+        "n_workers": n,
+        "age_sequence": age_seq,
+        "seed_age_zero": age_seq[0] == 0.0,
+        "steady_state_age_one": (
+            bool(steady_pre) and all(a == 1.0 for a in steady_pre)
+        ),
+        "swap_transition_age_zero": bool(post) and post[0] == 0.0,
+        "post_swap_steady_one": all(a == 1.0 for a in post[1:]),
+    }
+    print(json.dumps(delayed_line))
+    staleness.stop()
+
+    # -- claim 3: age-discounted mixing shrinks the health residual ----------
+    bf.set_topology(topo.RingGraph(n))
+    opt_r = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.0))
+    ts_r = opt_r.make_train_step(consensus_loss, delayed=True)
+    p_r = {"w": bf.worker_values(
+        lambda r: np.random.RandomState(100 + r).randn(2048)
+        .astype(np.float32)
+    )}
+    s_r = opt_r.init(p_r)
+    obs = staleness.start(interval=1)
+    plane = health.HealthPlane(interval=1)  # driven directly, not installed
+    last = None
+    for t in range(40):
+        p_r, s_r, _loss = ts_r(p_r, s_r, x_d)
+        w = np.asarray(p_r["w"], np.float64)
+        d = float(np.sqrt(((w - w.mean(0)) ** 2).sum(1)).mean())
+        last = plane.observe(ctx, step=t, consensus=d)
+    eff = last.get("mixing_efficiency")
+    eff_adj = last.get("mixing_efficiency_age_adjusted")
+    residual_raw = abs(eff - 1.0) if eff is not None else None
+    residual_adj = abs(eff_adj - 1.0) if eff_adj is not None else None
+    print(json.dumps({
+        "metric": "staleness_residual",
+        "n_workers": n,
+        "predicted_rate": last.get("predicted_rate"),
+        "age_adjusted_rate": last.get("age_adjusted_rate"),
+        "measured_rate": last.get("measured_rate"),
+        "age_mean": last.get("age_mean"),
+        "mixing_efficiency": eff,
+        "mixing_efficiency_age_adjusted": eff_adj,
+        "residual_raw": residual_raw,
+        "residual_age_adjusted": residual_adj,
+        "residual_shrinks": (
+            residual_raw is not None and residual_adj is not None
+            and residual_adj < residual_raw
+        ),
+    }))
+    staleness.stop()
+
+    # -- claim 4: overhead / structural / bitwise pins -----------------------
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    xs_b = bf.worker_values(
+        lambda r: rng.randn(batch, dim).astype(np.float32)
+    )
+    ys_b = bf.worker_values(
+        lambda r: rng.randn(batch, dim).astype(np.float32)
+    )
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def make_stepper():
+        opt_s = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+        train_step = bf.make_train_step(opt_s, loss_fn)
+        params_s = {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+        carry = [(params_s, opt_s.init(params_s))]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs_b, ys_b)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, carry
+
+    # structural pin: enabling staleness adds no train-step cache entry
+    staleness.stop()
+    stepper, _carry = make_stepper()
+    stepper()
+    stepper()
+
+    def train_keys():
+        return {
+            k for k in ctx.op_cache
+            if isinstance(k, tuple) and k
+            and k[0] in ("opt_step", "opt_fused_step")
+        }
+
+    keys_off = train_keys()
+    staleness.start(interval=1)
+    stepper()
+    stepper()
+    keys_on = train_keys()
+    lane_keys = [
+        k for k in ctx.op_cache
+        if isinstance(k, tuple) and k and k[0] == "staleness_lane"
+    ]
+    unsampled_shared = keys_on == keys_off
+    staleness.stop()
+
+    # bitwise trajectory pin
+    state_bits = {}
+    for variant in ("off", "on"):
+        if variant == "on":
+            staleness.start(interval=3)
+        else:
+            staleness.stop()
+        _step, carry = make_stepper()
+        for _ in range(12):
+            _step()
+        state_bits[variant] = jax.tree_util.tree_leaves(carry[0])
+    staleness.stop()
+    bitwise = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(state_bits["off"], state_bits["on"])
+    )
+
+    # overhead at the default interval, all-orderings rotation + A/A
+    steppers = {}
+    obs_on = staleness.StalenessObservatory(interval=1)
+    for variant in ("off", "on", "off2"):
+        staleness.activate(obs_on if variant == "on" else None)
+        steppers[variant], _ = make_stepper()
+        steppers[variant]()  # compile (+ lane compile for "on")
+        _settle(steppers[variant]())
+    orders = list(itertools.permutations(("off", "on", "off2")))
+    times = {v: [] for v in steppers}
+    for i in range(samples):
+        for variant in orders[i % len(orders)]:
+            staleness.activate(obs_on if variant == "on" else None)
+            t0 = time_mod.perf_counter()
+            _settle(steppers[variant]())
+            times[variant].append(time_mod.perf_counter() - t0)
+    staleness.activate(None)
+
+    def median(v):
+        v = sorted(v)
+        return v[len(v) // 2] if v else 0.0
+
+    base_s = median(times["off"])
+    sample_extra_s = median(
+        [on - off for off, on in zip(times["off"], times["on"])]
+    )
+    control_extra_s = median(
+        [o2 - off for off, o2 in zip(times["off"], times["off2"])]
+    )
+    overhead_pct = (
+        100.0 * sample_extra_s / default_interval / base_s
+        if base_s > 0 else 0.0
+    )
+    control_pct = (
+        100.0 * control_extra_s / default_interval / base_s
+        if base_s > 0 else 0.0
+    )
+    print(json.dumps({
+        "metric": "staleness_overhead",
+        "n_workers": n,
+        "payload_mb": round(layers * dim * dim * 4 / 1e6, 2),
+        "interval": default_interval,
+        "ms_per_step_off": round(base_s * 1e3, 3),
+        "ms_sampled_step_extra": round(sample_extra_s * 1e3, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "control_aa_pct": round(control_pct, 3),
+        "unsampled_program_shared": unsampled_shared,
+        "staleness_lane_programs": len(lane_keys),
+        "bitwise_identical": bitwise,
+        "samples": samples,
+    }))
+
+    # -- claim 5: per-edge stall chaos → age spike + breach naming -----------
+    bf.shutdown()
+    bf.init(devices=devices[:n])
+    ctx = bf.get_context()
+    bf.set_topology(topo.RingGraph(n))
+    stall_src = int(os.environ.get("BENCH_STALENESS_STALL_RANK", "2"))
+    stall_dst = (stall_src + 1) % n
+    hold_steps = 6
+    stall_at = 4
+    session = bf.elastic.start(policy="average")
+    session.inject("stall", rank=stall_src, step=stall_at,
+                   steps=hold_steps, peer=stall_dst)
+    obs = staleness.start(interval=1)  # default bound 4 < spike of 6
+    opt_c = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.01))
+    guard = bf.elastic.guard(opt_c)
+    p_c = {"w": bf.worker_values(
+        lambda r: rng.randn(2048).astype(np.float32)
+    )}
+    s_c = opt_c.init(p_c)
+    g_c = {"w": bf.worker_values(
+        lambda r: np.zeros(2048, np.float32)
+    )}
+    for _ in range(stall_at + hold_steps + 4):
+        p_c, s_c = guard.step(p_c, s_c, g_c)
+    spike = [
+        s["age_max"] for s in obs.samples
+        if s.get("max_edge") == [stall_src, stall_dst]
+    ]
+    other_edges_clean = all(
+        rec["max"] == 0.0
+        for edge, rec in obs.report()["edge_ages"].items()
+        if edge != f"{stall_src}->{stall_dst}"
+    )
+    breaches = [
+        a.to_json() for a in obs.advisories
+        if a.kind == "staleness_breach"
+    ]
+    named = sorted({
+        tuple(e) for a in breaches for e in a.get("edges", [])
+    })
+    named_correctly = (
+        named == [(stall_src, stall_dst)]
+    )
+    lane_ok_throughout = all(s["lane_ok"] for s in obs.samples)
+    print(json.dumps({
+        "metric": "staleness_chaos",
+        "injected_edge": [stall_src, stall_dst],
+        "hold_steps": hold_steps,
+        "measured_spike_max": max(spike, default=0.0),
+        "spike_matches_hold": max(spike, default=0.0) == hold_steps,
+        "other_edges_age_zero": other_edges_clean,
+        "bound": obs.bound,
+        "breaches": breaches[:3],
+        "edges_named": [list(e) for e in named],
+        "named_correctly": named_correctly,
+        "lane_selfcheck_ok": lane_ok_throughout,
+    }))
+    staleness.stop()
+    bf.elastic.stop()
+
+    bf_metrics.flush()
+    for k, v in old_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        assert ages_all_zero, (
+            "synchronous-path delivered age was not identically 0: "
+            f"{sync_samples}"
+        )
+        assert sidecar_delta == scaling.LINEAGE_TAG_BYTES, (
+            f"lineage sidecar mispriced: {sidecar_delta} != "
+            f"{scaling.LINEAGE_TAG_BYTES}"
+        )
+        assert delayed_line["steady_state_age_one"], (
+            f"delayed path steady-state age != 1: {age_seq}"
+        )
+        assert delayed_line["swap_transition_age_zero"], (
+            f"topology-swap reseed transition not observed: {age_seq}"
+        )
+        assert residual_raw is not None and residual_adj is not None, (
+            "health residual comparison incomplete: "
+            f"raw={residual_raw} adj={residual_adj}"
+        )
+        assert residual_adj < residual_raw, (
+            "age-discounted mixing did not shrink the residual: "
+            f"raw={residual_raw} adj={residual_adj}"
+        )
+        assert unsampled_shared, (
+            "enabling the staleness observatory changed the compiled "
+            "train-step cache entries"
+        )
+        assert bitwise, (
+            "enabling the staleness observatory changed the training "
+            "state bitwise"
+        )
+        assert overhead_pct <= 1.0, (
+            f"staleness overhead {overhead_pct:.3f}% exceeds the 1% "
+            f"acceptance bound at interval {default_interval}"
+        )
+        assert max(spike, default=0.0) == hold_steps, (
+            f"measured age spike {max(spike, default=0.0)} != injected "
+            f"hold {hold_steps}"
+        )
+        assert other_edges_clean, "uninjected edges measured stale"
+        assert named_correctly, (
+            f"staleness_breach failed to name the injected edge "
+            f"({stall_src}, {stall_dst}): named {named}"
+        )
+        assert lane_ok_throughout, "lane self-check failed under chaos"
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -3063,8 +3509,8 @@ def run_all() -> int:
     import subprocess
 
     for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
-                 "flight", "attribution", "health", "quant", "gossip",
-                 "flash", "transformer"):
+                 "flight", "attribution", "health", "staleness",
+                 "quant", "gossip", "flash", "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -3107,6 +3553,7 @@ def main() -> int:
         "flight": run_flight,
         "attribution": run_attribution,
         "health": run_health,
+        "staleness": run_staleness,
         "quant": run_quant,
         "gossip": run_gossip_overhead,
         "transformer": run_transformer,

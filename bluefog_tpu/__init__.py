@@ -52,6 +52,7 @@ from bluefog_tpu.windows import (
     win_mutex,
     win_read,
     get_win_version,
+    get_win_age,
     get_current_created_window_names,
     turn_on_win_ops_with_associated_p,
     turn_off_win_ops_with_associated_p,
@@ -91,6 +92,7 @@ from bluefog_tpu.flight import dump as flight_dump
 from bluefog_tpu import attribution
 from bluefog_tpu import attribution as doctor  # bf.doctor facade
 from bluefog_tpu import health
+from bluefog_tpu import staleness
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
     metrics_export,
@@ -307,6 +309,7 @@ __all__ = [
     "win_mutex",
     "win_read",
     "get_win_version",
+    "get_win_age",
     "get_current_created_window_names",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
@@ -339,6 +342,7 @@ __all__ = [
     "attribution",
     "doctor",
     "health",
+    "staleness",
     "metrics",
     "metrics_snapshot",
     "metrics_export",

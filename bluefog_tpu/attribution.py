@@ -244,6 +244,49 @@ def blame_edges(
     return out
 
 
+def suspect_join(include_stall_holds: bool = False) -> List[Any]:
+    """Edges/ranks that corroborate a fabric-health advisory: the
+    chaos layer's active degrade faults (and, with
+    ``include_stall_holds``, its active stall payload holds) plus this
+    doctor's recent ``degraded_link`` edges. One implementation for
+    the health plane's ``mixing_degraded`` suspects and the staleness
+    observatory's ``staleness_breach`` suspects — the detectors prove
+    a contract is broken; this join names who plausibly broke it.
+    Edges render as ``[src, dst]``, rank-wide faults as
+    ``{"rank": n}``."""
+    out: List[Any] = []
+
+    def add(key):
+        item = (
+            [int(key[0]), int(key[1])] if isinstance(key, tuple)
+            else {"rank": int(key)}
+        )
+        if item not in out:
+            out.append(item)
+
+    try:
+        from bluefog_tpu import elastic as elastic_mod
+
+        session = elastic_mod.active_session()
+    except Exception:
+        session = None
+    if session is not None:
+        if include_stall_holds:
+            holds = getattr(session, "simulated_stale_steps", None)
+            for key in sorted(holds() if holds else {}, key=str):
+                add(key)
+        for key in sorted(session.simulated_wire_factors(), key=str):
+            add(key)
+    doc = active()
+    if doc is not None:
+        for adv in doc.advisories[-8:]:
+            if adv.kind == "degraded_link":
+                edge = adv.detail.get("edge")
+                if edge is not None and edge not in out:
+                    out.append(edge)
+    return out
+
+
 # -- the doctor ---------------------------------------------------------------
 
 
@@ -771,13 +814,10 @@ class StepDoctor:
 
     def _export_line(self, obj: dict) -> None:
         path = os.environ.get(FILE_ENV)
-        if not path:
-            return
-        try:
-            with open(path, "a") as f:
-                f.write(json.dumps({"ts": time.time(), **obj}) + "\n")
-        except OSError:
-            pass
+        if path:
+            from bluefog_tpu.logging_util import append_jsonl
+
+            append_jsonl(FILE_ENV, path, obj)
 
     # -- dump ------------------------------------------------------------------
 

@@ -996,6 +996,33 @@ def pair_gossip(
     return jnp.where(paired, gossiped, xw)
 
 
+def lineage_exchange(
+    tags: jnp.ndarray,
+    perms,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Ship each round's lineage tag along that round's ppermute — the
+    staleness observatory's provenance lane (:mod:`bluefog_tpu.
+    staleness`).
+
+    ``tags`` is this rank's per-round stamp ``[n_rounds, k]`` int32
+    (``(birth_step, topo_version, epoch)``, one row per round so
+    edge-narrowed chaos holds can stamp a single round differently);
+    the return is the delivered tag per round, ``[n_rounds, k]`` —
+    rounds in which this rank receives nothing carry zeros, exactly
+    like any other non-destination ppermute payload. The exchange uses
+    the SAME perm decomposition as the data wire, so a delivered tag
+    is proof the corresponding data edge delivered this sample.
+    """
+    outs = [
+        lax.ppermute(tags[r], axis_name, perm)
+        for r, perm in enumerate(perms)
+    ]
+    if not outs:
+        return jnp.zeros_like(tags)
+    return jnp.stack(outs)
+
+
 def barrier(axis_name: str) -> jnp.ndarray:
     """A full synchronization point: psum of a unit scalar. The eager facade
     blocks on the result (reference ``MPI_Barrier``, mpi_controller.cc:1185)."""

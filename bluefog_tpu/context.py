@@ -387,6 +387,12 @@ def init(
     # BLUEFOG_HEALTH_PORT serving): fresh session per mesh, same
     # stale-baseline rationale as the doctor.
     _health.on_init(_context)
+    # Staleness observatory (BLUEFOG_STALENESS=1): fresh session per
+    # mesh — a torn-down mesh's per-edge age table must not alias the
+    # new graph's edges.
+    from bluefog_tpu import staleness as _staleness
+
+    _staleness.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -408,9 +414,12 @@ def shutdown() -> None:
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
+    from bluefog_tpu import staleness as _staleness
+
     _elastic.stop()
     _attribution.on_shutdown()
     _health.on_shutdown()
+    _staleness.on_shutdown()
     if _context is not None:
         # session_end lands in the ring (and the crash hooks detach)
         # while the timeline is still open for the clock pairing

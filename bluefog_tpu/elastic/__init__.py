@@ -108,16 +108,19 @@ def active_session() -> Optional[ElasticSession]:
 
 
 def inject(kind: str, rank: int, step: int, *, seconds: float = 0.0,
-           factor: float = 1.0, peer: int = -1) -> Fault:
+           factor: float = 1.0, peer: int = -1, steps: int = 0) -> Fault:
     """Schedule a fault on the active session's step clock (the
     programmatic twin of ``BLUEFOG_FAULT_PLAN``). ``peer`` narrows a
-    degrade fault to the single directed edge ``(rank, peer)``."""
+    degrade or stall fault to the single directed edge ``(rank,
+    peer)``; ``steps`` gives a stall its step-clock extent (payload
+    held for the staleness observatory's wire-age simulation)."""
     if _session is None:
         raise RuntimeError(
             "no active elastic session; call bf.elastic.start() first"
         )
     return _session.inject(
-        kind, rank, step, seconds=seconds, factor=factor, peer=peer
+        kind, rank, step, seconds=seconds, factor=factor, peer=peer,
+        steps=steps,
     )
 
 

@@ -13,6 +13,7 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
 
     kill:rank=3,step=5
     stall:rank=2,step=10,seconds=120
+    stall:rank=2,step=10,steps=6,peer=3
     degrade:rank=1,step=4,factor=0.25
 
 - ``kill``     — the rank is dead from ``step`` on (process crash).
@@ -20,7 +21,14 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
   or past the liveness deadline (``BLUEFOG_LIVENESS_TIMEOUT``) is
   condemned exactly like a kill; a shorter one is recorded (counter +
   timeline marker) and survives — transient slowness must NOT trigger
-  repair.
+  repair. An optional ``steps=S`` declares the stall's length on the
+  session step clock: for ``S`` steps from ``step`` on, the rank's
+  outbound payload is frozen at its pre-stall version, so the
+  staleness observatory's lineage lane measures a growing delivered
+  age on its out-edges (:meth:`~bluefog_tpu.elastic.recovery.
+  ElasticSession.simulated_stale_steps` — the wire-age analogue of the
+  degrade faults' ``simulated_wire_factors``). ``peer=P`` narrows the
+  hold to the single directed edge ``(rank, P)``.
 - ``degrade``  — from ``step`` on the rank's gossip edges are scaled by
   ``factor`` (and receiver weights renormalized) at the next repair:
   the TopoOpt-style "co-optimize around a slow link" response. An
@@ -55,13 +63,19 @@ class Fault:
     kind: str
     rank: int
     step: int
-    seconds: float = 0.0  # stall duration (simulated)
+    seconds: float = 0.0  # stall duration (simulated wall time)
     factor: float = 1.0  # degrade link-quality scale
-    # degrade target: -1 degrades every edge of `rank`; a peer rank
-    # narrows it to the single directed edge (rank, peer) — the form
-    # the attribution doctor's degraded-link localization is tested
-    # against (a single slow link, not a slow host)
+    # fault target: -1 covers every edge of `rank`; a peer rank narrows
+    # a degrade (slow link) or a stall hold (stale link) to the single
+    # directed edge (rank, peer) — the form the attribution doctor's
+    # degraded-link localization and the staleness observatory's
+    # breach naming are tested against
     peer: int = -1
+    # stall length on the session STEP clock: while active, the rank's
+    # outbound payload is frozen at its pre-stall version (the
+    # staleness observatory's deterministic age simulation); 0 = the
+    # stall has no step-clock extent (wall-time only)
+    hold_steps: int = 0
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -78,10 +92,19 @@ class Fault:
             raise ValueError(
                 f"degrade factor must be in (0, 1], got {self.factor}"
             )
-        if self.peer >= 0 and self.kind != "degrade":
+        if self.peer >= 0 and self.kind not in ("degrade", "stall"):
             raise ValueError(
-                f"peer= only applies to degrade faults, got kind "
+                f"peer= only applies to degrade and stall faults, got "
+                f"kind {self.kind!r}"
+            )
+        if self.hold_steps and self.kind != "stall":
+            raise ValueError(
+                f"steps= only applies to stall faults, got kind "
                 f"{self.kind!r}"
+            )
+        if self.hold_steps < 0:
+            raise ValueError(
+                f"stall steps must be >= 0, got {self.hold_steps}"
             )
 
 
@@ -98,11 +121,13 @@ def _parse_clause(clause: str) -> Fault:
                 )
             k, v = pair.split("=", 1)
             fields[k.strip().lower()] = v.strip()
-    unknown = set(fields) - {"rank", "step", "seconds", "factor", "peer"}
+    unknown = set(fields) - {
+        "rank", "step", "seconds", "factor", "peer", "steps",
+    }
     if unknown:
         raise ValueError(
             f"unknown fault fields {sorted(unknown)} in {clause!r}; "
-            "accepted: rank, step, seconds, factor, peer"
+            "accepted: rank, step, seconds, factor, peer, steps"
         )
     for required in ("rank", "step"):
         if required not in fields:
@@ -116,6 +141,7 @@ def _parse_clause(clause: str) -> Fault:
         seconds=float(fields.get("seconds", 0.0)),
         factor=float(fields.get("factor", 1.0)),
         peer=int(fields.get("peer", -1)),
+        hold_steps=int(fields.get("steps", 0)),
     )
 
 

@@ -213,14 +213,17 @@ class ElasticSession:
     # -- chaos replay --------------------------------------------------------
 
     def inject(self, kind: str, rank: int, step: int, *, seconds: float = 0.0,
-               factor: float = 1.0, peer: int = -1) -> Fault:
+               factor: float = 1.0, peer: int = -1,
+               steps: int = 0) -> Fault:
         """Programmatic fault injection (the ``BLUEFOG_FAULT_PLAN`` API
         twin): schedule a fault on this session's own step clock.
-        ``peer`` narrows a degrade fault to the single directed edge
-        ``(rank, peer)``."""
+        ``peer`` narrows a degrade (or stall) fault to the single
+        directed edge ``(rank, peer)``; ``steps`` gives a stall its
+        step-clock extent (the staleness observatory's deterministic
+        payload-hold simulation)."""
         fault = Fault(kind=kind, rank=int(rank), step=int(step),
                       seconds=float(seconds), factor=float(factor),
-                      peer=int(peer))
+                      peer=int(peer), hold_steps=int(steps))
         if not 0 <= fault.rank < self.ctx.size:
             raise ValueError(
                 f"rank {fault.rank} out of range for {self.ctx.size} workers"
@@ -247,6 +250,29 @@ class ElasticSession:
                 out[key] = min(out.get(key, 1.0), f.factor)
         return out
 
+    def simulated_stale_steps(self) -> Dict:
+        """Stall faults with a step-clock extent (``steps=``) active at
+        the current session step, as a ``{(src, dst) | rank:
+        extra_age}`` map — the staleness observatory's deterministic
+        wire simulation (:mod:`bluefog_tpu.staleness`, the age
+        analogue of :meth:`simulated_wire_factors`).
+
+        A rank stalled since fault step ``s`` keeps shipping its
+        step-``s`` payload: at session step ``t`` in ``[s, s +
+        steps)`` the held payload is ``t - s + 1`` steps older than a
+        live sender's would be (the rank froze BEFORE this step's
+        send), so the measured age ramps 1, 2, ..., ``steps`` and then
+        recovers — exactly the spike the chaos evidence pins."""
+        out: Dict = {}
+        for f in self.plan.faults:
+            if f.kind != "stall" or f.hold_steps <= 0:
+                continue
+            k = self.step - f.step
+            if 0 <= k < f.hold_steps:
+                key = (f.rank, f.peer) if f.peer >= 0 else f.rank
+                out[key] = max(out.get(key, 0), k + 1)
+        return out
+
     def _apply_fault(self, fault: Fault, step: int) -> None:
         metrics_mod.counter("bluefog.elastic.faults").inc()
         # the fault event carries the topology version it fired under:
@@ -256,7 +282,8 @@ class ElasticSession:
         flight.note_fault(
             fault_kind=fault.kind, rank=fault.rank, step=step,
             seconds=fault.seconds, factor=fault.factor,
-            peer=fault.peer, topo_version=self.ctx.topo_version,
+            peer=fault.peer, hold_steps=fault.hold_steps,
+            topo_version=self.ctx.topo_version,
         )
         if fault.kind == "kill":
             if self.membership.mark_dead(fault.rank, "killed", step):

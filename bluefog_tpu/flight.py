@@ -49,10 +49,16 @@ What gets recorded (event ``kind`` -> payload):
 - ``membership`` / ``fault`` / ``repair`` — elastic verdicts with
   epoch, reason, and the topology version the verdict was filed under.
 - ``stall`` — watchdog deadline hits.
-- ``advisory`` — doctor diagnoses (:mod:`bluefog_tpu.attribution`):
-  degraded_link / straggler / recompile_storm / consensus_stall /
-  ambient_drift, with their evidence, kept eviction-proof in a side
-  table like faults.
+- ``advisory`` — observability diagnoses (:mod:`bluefog_tpu.
+  attribution` degraded_link / straggler / recompile_storm /
+  consensus_stall / ambient_drift, :mod:`bluefog_tpu.health`
+  mixing_degraded, :mod:`bluefog_tpu.staleness` staleness_breach),
+  with their evidence, kept eviction-proof in a side table like
+  faults.
+- ``staleness`` — per-sample delivered-age summaries from the
+  staleness observatory's lineage lane (surface, mean/max age, lane
+  self-check), so a postmortem can see whether data was going stale
+  in the steps before a hang.
 - ``crash`` / ``sigterm`` — the run's last words.
 
 Dump triggers: a watchdog stall, an elastic SUSPECT/DEAD verdict, an

@@ -139,7 +139,17 @@ FLEET_FIELDS = (
     "wire_bytes_per_step", # wire bytes per communicating step
     "advisories",          # advisories on record (health + doctor)
     "live_digest",         # digest of the believed live set
+    "stale_age_max",       # worst delivered parameter age on record
+    #                        (bluefog_tpu.staleness; 0 when the
+    #                        observatory is off) — fleet-wide
+    #                        min/mean/max age rides the same lane
 )
+
+
+# Non-finite sanitizer for the HTTP endpoints (the JSONL exporters get
+# it through logging_util.append_jsonl): a NaN step EWMA before warmup
+# must reach the scraper as null, never as a bare NaN token.
+from bluefog_tpu.logging_util import json_safe as _json_safe  # noqa: E402
 
 
 def enabled() -> bool:
@@ -594,19 +604,27 @@ class HealthPlane:
             mats = [p.weight_matrix() for p in plan.plans]
             rate = topo_mod.consensus_decay_rate(mats)
             kind = f"schedule(period={len(mats)})"
+            self_w = float(np.mean([np.mean(np.diag(m)) for m in mats]))
         elif isinstance(plan, CommPlan):
-            rate = topo_mod.consensus_decay_rate(plan.weight_matrix())
+            w = plan.weight_matrix()
+            rate = topo_mod.consensus_decay_rate(w)
             kind = "plan"
+            self_w = float(np.mean(np.diag(w)))
         else:
-            rate = topo_mod.consensus_decay_rate(
-                topo_mod.mixing_matrix(ctx.load_topology())
-            )
+            w = topo_mod.mixing_matrix(ctx.load_topology())
+            rate = topo_mod.consensus_decay_rate(w)
+            self_w = float(np.mean(np.diag(w)))
+        # mean self weight of the active combine: the `s` of the
+        # stale-mixing companion polynomial the age-discounted
+        # prediction solves (bluefog_tpu.staleness.age_adjusted_rate)
+        meta = {"kind": kind, "slem": float(rate),
+                "self_weight": self_w}
         if rate >= 1.0 - 1e-9:
             # no contraction promised (disconnected / periodic):
             # publish "no prediction" rather than a vacuous 1.0
-            out = (None, {"kind": kind, "slem": float(rate)})
+            out = (None, meta)
         else:
-            out = (float(rate), {"kind": kind, "slem": float(rate)})
+            out = (float(rate), meta)
         self._spectral_cache[key] = out
         return out
 
@@ -615,37 +633,13 @@ class HealthPlane:
     @staticmethod
     def _suspect_edges() -> List[Any]:
         """Edges/ranks to name in a ``mixing_degraded`` advisory: the
-        chaos layer's active degrade faults and the attribution
-        doctor's recent ``degraded_link`` edges. The observatory
-        detects the broken contract; the wire layers localize it."""
-        out: List[Any] = []
-        try:
-            from bluefog_tpu import elastic as elastic_mod
+        shared fabric-health join (:func:`bluefog_tpu.attribution.
+        suspect_join` — chaos degrade faults + recent
+        ``degraded_link`` edges). The observatory detects the broken
+        contract; the wire layers localize it."""
+        from bluefog_tpu.attribution import suspect_join
 
-            session = elastic_mod.active_session()
-        except Exception:
-            session = None
-        if session is not None:
-            for key in sorted(
-                session.simulated_wire_factors(), key=str
-            ):
-                if isinstance(key, tuple):
-                    out.append([int(key[0]), int(key[1])])
-                else:
-                    out.append({"rank": int(key)})
-        try:
-            from bluefog_tpu import attribution
-
-            doc = attribution.active()
-        except Exception:
-            doc = None
-        if doc is not None:
-            for adv in doc.advisories[-8:]:
-                if adv.kind == "degraded_link":
-                    edge = adv.detail.get("edge")
-                    if edge is not None and edge not in out:
-                        out.append(edge)
-        return out
+        return suspect_join()
 
     # -- observation ----------------------------------------------------------
 
@@ -721,7 +715,21 @@ class HealthPlane:
             % 1_000_003
         )
         vec[:, 4] = digest
+        vec[:, 5] = self._staleness_age_max()
         return vec
+
+    @staticmethod
+    def _staleness_age_max() -> float:
+        """Worst delivered parameter age this controller has measured
+        (0.0 when the staleness observatory is off) — aggregated
+        fleet-wide min/mean/max over the push-sum lane."""
+        try:
+            from bluefog_tpu import staleness as stal_mod
+
+            obs = stal_mod.active()
+            return float(obs.last_age_max()) if obs is not None else 0.0
+        except Exception:
+            return 0.0
 
     def _fleet_step(self, ctx, values: np.ndarray,
                     dead: Sequence[int],
@@ -889,6 +897,37 @@ class HealthPlane:
             sample["time_to_eps_steps"] = round(tte, 1)
             sample["eps"] = self.eps
 
+        # -- age-discounted effective mixing (bluefog_tpu.staleness) ---------
+        # The spectral prediction assumes zero staleness; under
+        # delayed=True or window-op exchanges it silently overstates
+        # the promised contraction. When the staleness observatory is
+        # measuring delivered age, correct the promise through the
+        # stale-mixing companion polynomial — the corrected efficiency
+        # is what the fabric can honestly be held to.
+        eff_adj = None
+        try:
+            from bluefog_tpu import staleness as stal_mod
+
+            obs = stal_mod.active()
+            age = obs.last_age_mean() if obs is not None else None
+        except Exception:
+            age = None
+        if age and predicted is not None:
+            adj = stal_mod.age_adjusted_rate(
+                predicted, age, spec_meta.get("self_weight", 0.5)
+            )
+            sample["age_mean"] = round(float(age), 4)
+            if adj is not None and adj != predicted:
+                sample["age_adjusted_rate"] = round(adj, 6)
+                eff_adj = mixing_efficiency(measured, adj)
+                if eff_adj is not None:
+                    sample["mixing_efficiency_age_adjusted"] = round(
+                        eff_adj, 4
+                    )
+                metrics_mod.gauge(
+                    "bluefog.health.age_adjusted_rate"
+                ).set(adj)
+
         found = []
         if eff is not None:
             tr = self._eff_tracker
@@ -989,6 +1028,10 @@ class HealthPlane:
             metrics_mod.gauge("bluefog.health.measured_rate").set(
                 measured
             )
+        if eff_adj is not None:
+            metrics_mod.gauge(
+                "bluefog.health.mixing_efficiency_age_adjusted"
+            ).set(eff_adj)
         if tte is not None:
             metrics_mod.gauge("bluefog.health.time_to_eps_steps").set(
                 tte
@@ -1029,13 +1072,10 @@ class HealthPlane:
 
     def _export_line(self, obj: dict) -> None:
         path = os.environ.get(FILE_ENV)
-        if not path:
-            return
-        try:
-            with open(path, "a") as f:
-                f.write(json.dumps({"ts": time.time(), **obj}) + "\n")
-        except OSError:
-            pass
+        if path:
+            from bluefog_tpu.logging_util import append_jsonl
+
+            append_jsonl(FILE_ENV, path, obj)
 
     # -- serving state / artifact ---------------------------------------------
 
@@ -1205,7 +1245,13 @@ class HealthServer:
                     if path == "/healthz":
                         v = healthz_verdict()
                         code = 503 if v["status"] == "critical" else 200
-                        self._send(code, json.dumps(v))
+                        # strict JSON: a NaN gauge must never reach the
+                        # scraper as a bare NaN token (allow_nan=False
+                        # is the regression tripwire — _json_safe
+                        # already replaced every non-finite value)
+                        self._send(code, json.dumps(
+                            _json_safe(v), allow_nan=False
+                        ))
                     elif path == "/metrics":
                         self._send(
                             200,
@@ -1220,7 +1266,9 @@ class HealthServer:
                                   "healthz": healthz_verdict(None),
                                   "fleet": None, "samples": []}
                         )
-                        self._send(200, json.dumps(body))
+                        self._send(200, json.dumps(
+                            _json_safe(body), allow_nan=False
+                        ))
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",

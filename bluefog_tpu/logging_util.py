@@ -15,7 +15,10 @@ info, warn, error, fatal.
 import logging
 import os
 
-__all__ = ["logger", "set_log_level", "TRACE"]
+__all__ = [
+    "logger", "set_log_level", "warn_once", "json_safe",
+    "append_jsonl", "TRACE",
+]
 
 TRACE = 5  # below logging.DEBUG, parity with the reference's trace level
 logging.addLevelName(TRACE, "TRACE")
@@ -47,6 +50,61 @@ def set_log_level(level: str) -> None:
 # a typo'd level (`vrbose`) silently eating the user's intended verbosity
 # was only discoverable by reading this file.
 _warned_levels = set()
+
+# Keys already warned about through warn_once — the BLUEFOG_LOG_LEVEL
+# discipline generalized: a misconfiguration that would otherwise fail
+# silently on EVERY sample (e.g. BLUEFOG_HEALTH_FILE pointing at a
+# directory that does not exist) must be loud exactly once.
+_warned_once = set()
+
+
+def warn_once(key: str, msg: str, *args) -> None:
+    """Log ``msg`` at WARNING level the first time ``key`` is seen;
+    later calls with the same key are silent. For per-sample failure
+    paths (telemetry exporters, probe dispatch) where one warning is
+    signal and a thousand are log spam."""
+    if key in _warned_once:
+        return
+    _warned_once.add(key)
+    logger.warning(msg, *args)
+
+
+def json_safe(obj):
+    """Replace non-finite floats with None, recursively — a NaN step
+    EWMA before warmup (or an Inf gauge) would otherwise serialize as
+    a bare ``NaN`` token, invalid JSON for strict parsers. Shared by
+    the JSONL exporters below and the health plane's HTTP endpoints."""
+    import math
+
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+
+def append_jsonl(env_name: str, path: str, obj: dict) -> None:
+    """Append one timestamped, non-finite-sanitized JSON line to a
+    telemetry stream — the ONE exporter behind the doctor, health, and
+    staleness ``BLUEFOG_*_FILE`` knobs. A write failure (typically the
+    env var pointing at a directory that does not exist) warns exactly
+    once per path instead of failing silently on every sample."""
+    import json
+    import time
+
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(
+                json_safe({"ts": time.time(), **obj})
+            ) + "\n")
+    except OSError as e:
+        warn_once(
+            f"export:{env_name}:{path}",
+            "cannot append %s sample to %s (%s) — further failures "
+            "for this path are silent", env_name, path, e,
+        )
 
 
 def _configure_from_env() -> None:

@@ -704,6 +704,22 @@ def _prom_name(name: str) -> str:
     return out if not out[:1].isdigit() else "_" + out
 
 
+def _prom_val(v: float) -> str:
+    """Render one sample value in Prometheus exposition format. Python's
+    ``%g`` spells non-finite floats ``nan``/``inf``, which strict
+    exposition parsers reject — the format's own casings are ``NaN`` /
+    ``+Inf`` / ``-Inf`` (a NaN gauge, e.g. a step EWMA before warmup,
+    must degrade to an explicitly-unparseable-as-number token, not an
+    invalid line)."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return f"{v:g}"
+
+
 def prom_lines() -> list:
     """The registry rendered as Prometheus exposition lines, in
     DETERMINISTIC order (series sorted by raw name; fixed sub-line
@@ -721,23 +737,25 @@ def prom_lines() -> list:
             lines.append(f"# HELP {pname}_total bluefog_tpu series "
                          f"{name}")
             lines.append(f"# TYPE {pname}_total counter")
-            lines.append(f"{pname}_total {desc['value']:g}")
+            lines.append(f"{pname}_total {_prom_val(desc['value'])}")
         elif desc["type"] == "gauge":
             lines.append(f"# HELP {pname} bluefog_tpu series {name}")
             lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {desc['value']:g}")
+            lines.append(f"{pname} {_prom_val(desc['value'])}")
         else:
             lines.append(f"# HELP {pname} bluefog_tpu series {name}")
             lines.append(f"# TYPE {pname} summary")
             for q in Histogram.QUANTILES:
                 v = desc.get(f"p{int(q * 100)}")
                 if v is not None:
-                    lines.append(f'{pname}{{quantile="{q:g}"}} {v:g}')
-            lines.append(f"{pname}_count {desc['count']:g}")
-            lines.append(f"{pname}_sum {desc['sum']:g}")
+                    lines.append(
+                        f'{pname}{{quantile="{q:g}"}} {_prom_val(v)}'
+                    )
+            lines.append(f"{pname}_count {_prom_val(desc['count'])}")
+            lines.append(f"{pname}_sum {_prom_val(desc['sum'])}")
             for k in ("min", "max"):
                 if desc[k] is not None:
-                    lines.append(f"{pname}_{k} {desc[k]:g}")
+                    lines.append(f"{pname}_{k} {_prom_val(desc[k])}")
     return lines
 
 

@@ -60,6 +60,7 @@ from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
 from bluefog_tpu import health as health_mod
 from bluefog_tpu import metrics as metrics_mod
+from bluefog_tpu import staleness as staleness_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
 from bluefog_tpu.collective import compiler, inner, ops as col_ops
@@ -1117,6 +1118,13 @@ class _GossipOptimizer:
             health_mod.observe_step(
                 ctx, step=self._step_count - 1, plan=self._last_plan,
             )
+            # staleness observatory (BLUEFOG_STALENESS): the two-program
+            # path always gossips the fresh iterate — delivered age 0,
+            # the lane's per-sample self-check
+            staleness_mod.observe_step(
+                ctx, step=self._step_count - 1, plan=self._last_plan,
+                payload_age=0, surface="sync",
+            )
         if ef:
             self._ef = ef_out
         if met:
@@ -1156,6 +1164,11 @@ class _GossipOptimizer:
             bufs.append(jax.device_put(flat, sharding))
         self._delay_buf = tuple(bufs)
         self._delay_sig = sig
+        # provenance: a (re)seeded buffer holds the CURRENT params, so
+        # the next combine's payload age is 0 — the staleness
+        # observatory reads the age-0 transient at every topology swap
+        # / elastic repair, then the steady-state age-1 again
+        self._delay_birth_comm = self._comm_count
 
     def make_train_step(self, loss_fn, has_aux: bool = False,
                         delayed: bool = False):
@@ -1403,6 +1416,14 @@ class _GossipOptimizer:
                 "step_begin", step=self._step_count, comm=comm_now,
                 fused=True,
             )
+            # the comm index THIS dispatch runs at, and the age of the
+            # payload its combine consumes: 0 on the fresh path, comm
+            # steps since the delay buffer was written on the delayed
+            # path (1 in steady state, 0 right after a reseed)
+            cur_comm = self._comm_count
+            payload_age = (
+                cur_comm - self._delay_birth_comm if delay_now else 0
+            )
             self._step_count += 1
             if comm_now:
                 self._comm_count += 1
@@ -1472,6 +1493,18 @@ class _GossipOptimizer:
                     ctx, step=self._step_count - 1,
                     plan=self._last_plan,
                 )
+                # staleness observatory: stamp the payload's REAL birth
+                # (the delayed path gossips the double-buffered
+                # previous iterate) and fold the delivered ages
+                staleness_mod.observe_step(
+                    ctx, step=self._step_count - 1,
+                    plan=self._last_plan, payload_age=payload_age,
+                    surface="delayed" if delay_now else "sync",
+                )
+                if delay_now:
+                    # the dispatch above refilled the double buffer
+                    # with this step's payload
+                    self._delay_birth_comm = cur_comm
             if has_aux:
                 return params_o, state_o, (loss, aux)
             return params_o, state_o, loss
@@ -1847,6 +1880,8 @@ class _WindowOptimizer:
             "window_optimizer_step_local", fn,
             win.value, win.p, opt_state, grads,
         )
+        # a local adapt ages the neighbor buffers by one local step
+        win_mod._note_local_step(win)
         return params_out, opt_state
 
     # -- the fused step -------------------------------------------------------
@@ -1972,6 +2007,14 @@ class _WindowOptimizer:
             "window_optimizer_step", fn,
             win.value, win.buffers, win.versions, win.p, win.p_buffers,
             opt_state, grads, wops,
+        )
+        # age lane: ONE dispatched program = one local step (exchange +
+        # combine fused), so the update note applies collect semantics
+        # without a second clock tick
+        win_mod._note_exchange_age(win, slot_table, ex_mode)
+        win_mod._note_update_age(win, up_part, reset, tick=False)
+        staleness_mod.observe_window(
+            ctx, win, step=self._step_count - 1
         )
         return params_out, opt_state
 

@@ -60,6 +60,7 @@ __all__ = [
     "plan_comm_summary",
     "wire_payload_bytes",
     "wire_bytes_per_step",
+    "LINEAGE_TAG_BYTES",
     "ring_allreduce_cost",
     "one_peer_gossip_cost",
     "weak_scaling_times",
@@ -79,9 +80,20 @@ _SCALE_BYTES_PER_BLOCK = {
     "int8": 4, "int8_ef": 4, "int4": 2, "int4_ef": 2,
 }
 
+# The staleness observatory's lineage tag: one int32 per
+# staleness.LINEAGE_FIELDS entry (birth_step, topo_version, membership
+# epoch), shipped once per edge per round on sampled steps. The single
+# definition lives with the fields in bluefog_tpu.staleness (stdlib +
+# numpy only, no import cycle) and is re-exported here — the
+# accounting home — so the observatory's wire-byte counter, the
+# evidence artifacts, and plan_comm_summary can never disagree with
+# the lane about what the provenance sidecar weighs.
+from bluefog_tpu.staleness import LINEAGE_TAG_BYTES  # noqa: E402
+
 
 def wire_payload_bytes(n_elems: int, itemsize: int,
-                       wire: Optional[str] = None) -> int:
+                       wire: Optional[str] = None,
+                       lineage: bool = False) -> int:
     """Bytes ONE round of one wire tier ships for an ``n_elems`` payload,
     scale sidecar included — the single accounting the chunk chooser,
     the metrics counters, and ``plan_comm_summary`` all price from (a
@@ -94,24 +106,29 @@ def wire_payload_bytes(n_elems: int, itemsize: int,
     4 B f32 scale per block; int4 = 256 B packed nibbles + 2 B bf16
     scale per block — exactly half of int8 at every payload size. bf16
     halves the raw bytes; fp32/unquantized ships ``itemsize`` per
-    element.
+    element. ``lineage=True`` adds the staleness observatory's
+    :data:`LINEAGE_TAG_BYTES` provenance sidecar (one tag per edge per
+    round, shipped on sampled steps only — callers price the sampled
+    dispatch, not every step).
     """
     from bluefog_tpu.collective.inner import _QUANT_CHUNK
 
+    extra = LINEAGE_TAG_BYTES if lineage else 0
     if wire in ("int8", "int8_ef", "int4", "int4_ef"):
         blocks = -(-int(n_elems) // _QUANT_CHUNK) if n_elems else 0
         per_block = (
             _QUANT_CHUNK if wire in ("int8", "int8_ef")
             else _QUANT_CHUNK // 2
         )
-        return blocks * (per_block + _SCALE_BYTES_PER_BLOCK[wire])
+        return blocks * (per_block + _SCALE_BYTES_PER_BLOCK[wire]) + extra
     if wire == "bf16":
-        return 2 * int(n_elems)
-    return int(itemsize) * int(n_elems)
+        return 2 * int(n_elems) + extra
+    return int(itemsize) * int(n_elems) + extra
 
 
 def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
-                        wire: Optional[str] = None) -> int:
+                        wire: Optional[str] = None,
+                        lineage: bool = False) -> int:
     """Per-worker wire bytes one gossip step puts on the interconnect.
 
     ``n_elems_by_itemsize`` maps payload dtype itemsize -> element count
@@ -119,11 +136,13 @@ def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
     wires replace the payload dtype per :func:`wire_payload_bytes`.
     Every round re-ships the payload, so the total scales with the
     plan's round count — the per-edge traffic accounting TopoOpt-style
-    co-optimization presumes."""
+    co-optimization presumes. ``lineage=True`` prices a staleness
+    lineage tag onto ONE dtype group per round (the tag is per edge,
+    not per payload group)."""
     per_round = sum(
         wire_payload_bytes(n, itemsize, wire)
         for itemsize, n in n_elems_by_itemsize.items()
-    )
+    ) + (LINEAGE_TAG_BYTES if lineage else 0)
     return per_round * n_rounds
 
 _DTYPE_BYTES = {
@@ -253,6 +272,7 @@ def plan_comm_summary(plan: CommPlan, payload_bytes: int,
             round(payload_bytes / wire_bytes, 4) if wire_bytes else 1.0
         ),
         "max_congestion": max(congestion, default=1.0),
+        "lineage_sidecar_bytes_per_round": LINEAGE_TAG_BYTES,
         "predicted_cost_us": plan_cost_s(rounds, wire_bytes) * 1e6,
         "naive_cost_us": plan_cost_s(naive_rounds, wire_bytes) * 1e6,
         "auto_chunks": auto_chunks,

@@ -71,6 +71,7 @@ __all__ = [
     "win_mutex",
     "win_read",
     "get_win_version",
+    "get_win_age",
     "get_current_created_window_names",
     "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
@@ -96,6 +97,22 @@ class _Window:
         self.out_neighbors = out_neighbors
         self.shape = shape
         self.dtype = dtype
+        # -- host-side age lane (bluefog_tpu.staleness) -------------------
+        # The device version lane counts writes since the last update;
+        # it cannot answer "how many local steps OLD is neighbor k's
+        # buffer". These host arrays can: `clock` counts local window
+        # steps (every dispatched op on this window — exchange, update,
+        # fused optimizer step, local adapt), `slot_written[r, k]` is
+        # the clock at the last write into rank r's slot k (age =
+        # clock - slot_written), and `mass_birth[r, k]` is the clock of
+        # the OLDEST uncollected win_accumulate mass in the slot (-1 =
+        # none pending) — so push-sum mass conservation and mass age
+        # are jointly visible (get_win_age(mass=True)).
+        size = len(in_neighbors)
+        max_deg = max((len(n) for n in in_neighbors), default=0)
+        self.clock = 0
+        self.slot_written = np.zeros((size, max(max_deg, 1)), np.int64)
+        self.mass_birth = np.full((size, max(max_deg, 1)), -1, np.int64)
 
     @property
     def max_deg(self) -> int:
@@ -315,6 +332,46 @@ def _slot_table(win: _Window, perms) -> np.ndarray:
                 )
             table[d, slot_of[d][s]] = r
     return table
+
+
+# -- the host-side age lane (bluefog_tpu.staleness) ---------------------------
+
+
+def _note_exchange_age(win: _Window, slot_table, mode: str) -> None:
+    """Advance the window's local-step clock and stamp the written
+    slots — called after every exchange dispatch (standalone ops AND
+    the fused window-optimizer step, which passes its own slot table).
+    Accumulates ('acc') additionally record the birth of the oldest
+    pending mass so push-sum mass age is answerable."""
+    win.clock += 1
+    written = np.asarray(slot_table) >= 0
+    if written.any():
+        win.slot_written[written] = win.clock
+        if mode == "acc":
+            fresh = written & (win.mass_birth < 0)
+            win.mass_birth[fresh] = win.clock
+
+
+def _note_update_age(win: _Window, participating, reset: bool,
+                     tick: bool = True) -> None:
+    """Advance the clock for a win_update; a resetting update collects
+    (zeroes) the participating ranks' buffers, so their pending-mass
+    birth marks clear — the slot ages themselves persist (non-reset
+    buffer content still dates from its write). ``tick=False`` applies
+    only the collect semantics: the fused window-optimizer step is ONE
+    dispatch whose clock advance already happened in
+    :func:`_note_exchange_age`."""
+    if tick:
+        win.clock += 1
+    if reset:
+        part = np.asarray(participating, bool)
+        win.mass_birth[part] = -1
+
+
+def _note_local_step(win: _Window) -> None:
+    """A between-communication local adapt counts as one local step:
+    neighbor buffers age while this rank trains without exchanging."""
+    win.clock += 1
 
 
 # -- the quantized window wire ------------------------------------------------
@@ -561,6 +618,7 @@ def _dispatch_exchange(win, ctx, mode, w_edges, participating, self_weight, x):
         jnp.asarray(np.asarray(self_vec, np.float64)),
         jnp.asarray(np.asarray(w_edges.sum(axis=1), np.float64)),
     )
+    _note_exchange_age(win, slot_table, mode)
     return win
 
 
@@ -833,6 +891,11 @@ def win_update(
     self_vec, w_recv, participating = _update_weights(
         ctx, win, self_weight, neighbor_weights
     )
+    # staleness observatory: the delivered-age fold happens at the
+    # consumption point — the ages the combine is about to mix
+    from bluefog_tpu import staleness as stal_mod
+
+    stal_mod.observe_window(ctx, win)
     fn = _update_fn(ctx, win, reset, _p_enabled())
     win.value, win.buffers, win.versions, win.p, win.p_buffers = fn(
         win.value, win.buffers, win.versions, win.p, win.p_buffers,
@@ -840,6 +903,7 @@ def win_update(
         jnp.asarray(np.asarray(_slot_weights(win, w_recv, ctx.size), np.float64)),
         jnp.asarray(participating, bool),
     )
+    _note_update_age(win, participating, reset)
     return win.value
 
 
@@ -860,10 +924,19 @@ def win_update_then_collect(name: str = None, require_mutex: bool = False):
 # -- versions / mutex / associated-p ----------------------------------------
 
 
-def get_win_version(name: str = None, rank: Optional[int] = None):
+def get_win_version(name: str = None, rank: Optional[int] = None,
+                    ages: bool = False):
     """Writes per in-neighbor buffer since the last ``win_update``.
     Per-rank dicts ``{in_neighbor: count}``; single dict when ``rank`` is
-    given (reference mpi_ops.py:1339-1386)."""
+    given (reference mpi_ops.py:1339-1386).
+
+    ``ages=True`` answers the question the write counter cannot — "how
+    many local steps old is neighbor k's buffer" — by delegating to
+    :func:`get_win_age` (the staleness observatory's window age lane):
+    where ``win_update`` resets the write counter, the age keeps
+    counting from the buffer's last write."""
+    if ages:
+        return get_win_age(name, rank)
     ctx = ctx_mod.get_context()
     win = _get_win(ctx, name)
     vers = np.asarray(win.versions)
@@ -871,6 +944,36 @@ def get_win_version(name: str = None, rank: Optional[int] = None):
         {s: int(vers[r, k]) for k, s in enumerate(win.in_neighbors[r])}
         for r in range(ctx.size)
     ]
+    return out[rank] if rank is not None else out
+
+
+def get_win_age(name: str = None, rank: Optional[int] = None,
+                mass: bool = False):
+    """Per in-neighbor buffer AGE in local window steps: how many
+    dispatched ops on this window (exchanges, updates, local adapts)
+    have passed since neighbor ``k``'s buffer slot was last written.
+    A freshly created window reports 0 everywhere (buffers initialize
+    to copies of the creating value).
+
+    ``mass=True`` reports the age of the OLDEST uncollected
+    ``win_accumulate`` mass per slot instead (``None`` when no mass is
+    pending) — the push-sum form, so mass conservation and mass
+    staleness are jointly visible. Per-rank dicts
+    ``{in_neighbor: age}``; single dict when ``rank`` is given. See
+    docs/staleness.md."""
+    ctx = ctx_mod.get_context()
+    win = _get_win(ctx, name)
+    clock = int(win.clock)
+    out = []
+    for r in range(ctx.size):
+        entry = {}
+        for k, s in enumerate(win.in_neighbors[r]):
+            if mass:
+                b = int(win.mass_birth[r, k])
+                entry[s] = (clock - b) if b >= 0 else None
+            else:
+                entry[s] = clock - int(win.slot_written[r, k])
+        out.append(entry)
     return out[rank] if rank is not None else out
 
 

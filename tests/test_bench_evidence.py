@@ -679,3 +679,64 @@ def test_bench_diff_wire_columns_are_tooling_gained(tmp_path):
     cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
     assert not cell.get("harness_change"), cell
     assert cell["verdict"].startswith("comparable"), cell
+
+
+def test_staleness_evidence_file_committed():
+    """STALENESS_EVIDENCE.json (the committed BENCH_MODE=staleness
+    output) carries the acceptance facts: synchronous-path delivered
+    age identically 0 with the lane self-check green and the lineage
+    sidecar priced by ``scaling.wire_payload_bytes``; delayed-path
+    steady-state age 1 with the topology-swap reseed transition;
+    the age-discounted mixing correction shrinking the health plane's
+    predicted-vs-measured residual on a delayed run; observatory
+    overhead <=1% at the default interval with the A/A control and the
+    structural + bitwise pins; and the chaos scenario where an
+    injected per-edge stall produces exactly the expected age spike
+    and ``staleness_breach`` names the edge — plus provenance and the
+    ambient anchor."""
+    path = os.path.join(REPO, "STALENESS_EVIDENCE.json")
+    assert os.path.exists(path), "STALENESS_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    sync = [l for l in lines if l.get("metric") == "staleness_sync"]
+    assert sync, lines
+    assert sync[0]["ages_all_zero"] is True
+    assert sync[0]["lane_selfcheck_ok"] is True
+    assert sync[0]["sidecar_priced_in_wire_payload_bytes"] is True
+    assert sync[0]["lineage_tag_bytes"] == 12
+    assert sync[0]["lane_wire_bytes_total"] > 0
+    delayed = [
+        l for l in lines if l.get("metric") == "staleness_delayed"
+    ]
+    assert delayed, lines
+    assert delayed[0]["seed_age_zero"] is True
+    assert delayed[0]["steady_state_age_one"] is True
+    assert delayed[0]["swap_transition_age_zero"] is True
+    residual = [
+        l for l in lines if l.get("metric") == "staleness_residual"
+    ]
+    assert residual, lines
+    assert residual[0]["residual_shrinks"] is True
+    assert residual[0]["residual_age_adjusted"] < \
+        residual[0]["residual_raw"]
+    assert residual[0]["age_mean"] is not None
+    overhead = [
+        l for l in lines if l.get("metric") == "staleness_overhead"
+    ]
+    assert overhead, lines
+    assert overhead[0]["overhead_pct"] <= 1.0
+    assert "control_aa_pct" in overhead[0]
+    assert overhead[0]["unsampled_program_shared"] is True
+    assert overhead[0]["bitwise_identical"] is True
+    chaos = [l for l in lines if l.get("metric") == "staleness_chaos"]
+    assert chaos, lines
+    assert chaos[0]["named_correctly"] is True
+    assert chaos[0]["spike_matches_hold"] is True
+    assert chaos[0]["other_edges_age_zero"] is True
+    assert chaos[0]["lane_selfcheck_ok"] is True
+    assert chaos[0]["injected_edge"] in chaos[0]["edges_named"]
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0

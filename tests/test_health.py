@@ -521,3 +521,95 @@ def test_doctor_triage_ingests_health_artifact(tmp_path):
     joined = " ".join(rep["summary"])
     assert "worst in the fleet" in joined
     assert "mixing_degraded" in joined
+
+
+# -- non-finite rendering + concurrent scrapes (staleness-PR satellites) ------
+
+
+def test_endpoints_survive_non_finite_gauges():
+    """Regression: a NaN gauge (e.g. a step EWMA before warmup) must
+    never reach a scraper as a bare ``NaN`` token — strict JSON
+    parsers reject it and a /fleet scrape turns into a parse error
+    exactly while the plane warms up. JSON surfaces degrade the value
+    to null; the Prometheus text surface uses the exposition format's
+    own ``NaN``/``+Inf`` casings."""
+    plane = health.start(interval=1)
+    plane._step_ewma_ms = float("nan")
+    with plane._report_lock:
+        plane.samples.append({
+            "kind": "sample", "step": 0,
+            "step_ms_ewma": float("nan"),
+            "consensus": float("inf"),
+            "nested": {"v": float("-inf"), "list": [float("nan")]},
+        })
+    metrics.gauge("bluefog.test.nan_gauge").set(float("nan"))
+    metrics.gauge("bluefog.test.inf_gauge").set(float("inf"))
+    srv = health.serve(0)
+    assert srv is not None
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def strict_loads(raw):
+        def reject(tok):
+            raise ValueError(f"non-finite token {tok!r} in JSON")
+
+        return json.loads(raw, parse_constant=reject)
+
+    fleet = strict_loads(urllib.request.urlopen(base + "/fleet").read())
+    last = fleet["samples"][-1]
+    assert last["step_ms_ewma"] is None
+    assert last["consensus"] is None
+    assert last["nested"]["v"] is None
+    assert last["nested"]["list"] == [None]
+    strict_loads(urllib.request.urlopen(base + "/healthz").read())
+    prom = urllib.request.urlopen(base + "/metrics").read().decode()
+    for line in prom.splitlines():
+        assert " nan" not in line and " inf" not in line, line
+    assert "bluefog_test_nan_gauge NaN" in prom
+    assert "bluefog_test_inf_gauge +Inf" in prom
+    srv.close()
+
+
+def test_concurrent_scrapes_while_plane_publishes():
+    """Two clients hammering /metrics and /fleet while the training
+    thread publishes sampled steps: every response must be a parseable
+    200 (the report-lock regression surface — deque mutation during
+    iteration turned scrapes into 500s exactly on sampled steps)."""
+    import threading
+
+    ctx = bf.get_context()
+    bf.set_topology(tu.RingGraph(SIZE))
+    plane = health.start(interval=1)
+    srv = health.serve(0)
+    assert srv is not None
+    base = f"http://127.0.0.1:{srv.port}"
+    errors = []
+    stop = threading.Event()
+
+    def scrape(path):
+        while not stop.is_set():
+            try:
+                raw = urllib.request.urlopen(base + path, timeout=5).read()
+                if path != "/metrics":
+                    json.loads(raw)
+            except Exception as e:  # any non-200 / parse failure
+                errors.append((path, repr(e)))
+                return
+
+    threads = [
+        threading.Thread(target=scrape, args=("/metrics",), daemon=True),
+        threading.Thread(target=scrape, args=("/fleet",), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    w = tu.mixing_matrix(bf.load_topology())
+    x = np.random.RandomState(0).randn(SIZE, 64)
+    for t_step in range(30):
+        x = w.T @ x
+        d = float(np.sqrt(((x - x.mean(0)) ** 2).sum(1)).mean())
+        metrics.gauge("bluefog.gossip.disagreement").set(d)
+        plane.observe(ctx, step=t_step, consensus=d)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    srv.close()
+    assert not errors, errors

@@ -1,0 +1,490 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Staleness-observatory tests: the lineage lane's delivered-age fold
+(sync ≡ 0 self-check, delayed ≡ 1 with reseed transitions), the
+sidecar pricing in ``scaling.wire_payload_bytes``, the age-adjusted
+mixing correction, chaos stall holds with ``staleness_breach``
+edge naming across every emission surface, window age semantics,
+the health-plane fleet field, and ``tools/staleness_report.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+import bluefog_tpu.topology as tu
+from bluefog_tpu import flight, health, metrics, scaling, staleness
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIZE = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_context(cpu_devices, monkeypatch):
+    for k in ("BLUEFOG_STALENESS", "BLUEFOG_STALENESS_INTERVAL",
+              "BLUEFOG_STALENESS_BOUND", "BLUEFOG_STALENESS_FILE",
+              "BLUEFOG_METRICS", "BLUEFOG_HEALTH"):
+        monkeypatch.delenv(k, raising=False)
+    metrics.reset()
+    bf.init(devices=cpu_devices[:SIZE])
+    yield
+    staleness.stop()
+    health.stop()
+    bf.elastic.stop()
+    bf.shutdown()
+    metrics.reset()
+
+
+def _consensus_problem(dim=1024):
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.01))
+    rng = np.random.RandomState(0)
+    params = {"w": bf.worker_values(
+        lambda r: rng.randn(dim).astype(np.float32)
+    )}
+    state = opt.init(params)
+    grads = {"w": bf.worker_values(
+        lambda r: np.zeros(dim, np.float32)
+    )}
+    return opt, params, state, grads
+
+
+# -- pure helpers -------------------------------------------------------------
+
+
+def test_age_adjusted_rate_identity_at_zero_age():
+    assert staleness.age_adjusted_rate(0.8, 0, 0.5) == 0.8
+    assert staleness.age_adjusted_rate(0.8, None, 0.5) == 0.8
+    assert staleness.age_adjusted_rate(None, 3, 0.5) is None
+
+
+def test_age_adjusted_rate_matches_quadratic_root():
+    """Age 1 must solve the PR-2 delayed stability quadratic
+    ``t^2 - s t - (λ - s) = 0`` exactly."""
+    lam, s = 0.805, 0.5
+    expected = (s + np.sqrt(s * s + 4 * (lam - s))) / 2.0
+    got = staleness.age_adjusted_rate(lam, 1, s)
+    assert got == pytest.approx(expected, abs=1e-12)
+    # a stale promise is always weaker (closer to 1) than the fresh one
+    assert got > lam
+    assert staleness.age_adjusted_rate(lam, 3, s) > got
+
+
+def test_lineage_sidecar_priced_into_wire_payload_bytes():
+    """The acceptance-criterion pin: lineage=True adds exactly
+    LINEAGE_TAG_BYTES to every wire tier's accounting."""
+    for wire in (None, "bf16", "int8", "int4", "int8_ef", "int4_ef"):
+        base = scaling.wire_payload_bytes(4096, 4, wire)
+        with_tag = scaling.wire_payload_bytes(4096, 4, wire,
+                                              lineage=True)
+        assert with_tag - base == scaling.LINEAGE_TAG_BYTES, wire
+    assert scaling.LINEAGE_TAG_BYTES == staleness.LINEAGE_TAG_BYTES
+    assert scaling.LINEAGE_TAG_BYTES == 4 * len(
+        staleness.LINEAGE_FIELDS
+    )
+
+
+def test_plan_comm_summary_reports_lineage_sidecar():
+    from bluefog_tpu.collective.plan import plan_from_topology
+
+    plan = plan_from_topology(tu.RingGraph(SIZE))
+    summary = scaling.plan_comm_summary(plan, 1 << 20)
+    assert summary["lineage_sidecar_bytes_per_round"] == \
+        scaling.LINEAGE_TAG_BYTES
+
+
+# -- the lineage lane ---------------------------------------------------------
+
+
+def test_sync_path_age_is_zero_and_lane_selfchecks():
+    """The synchronous combine delivers age 0 on every edge — the
+    observatory's per-sample proof that the lane itself is correct."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    obs = staleness.start(interval=1)
+    opt, params, state, grads = _consensus_problem()
+    for _ in range(4):
+        params, state = opt.step(params, state, grads)
+    assert len(obs.samples) == 4
+    for s in obs.samples:
+        assert s["surface"] == "sync"
+        assert s["age_max"] == 0.0
+        assert s["lane_ok"]
+        assert s["edges"] == 2 * SIZE  # directed ring edges
+    # every directed edge of the ring appears in the per-edge table
+    assert len(obs.edge_ages) == 2 * SIZE
+    # the aggregate histogram + gauges landed in the registry
+    assert metrics.peek("bluefog.staleness.age").count == 8 * SIZE
+    assert metrics.peek("bluefog.staleness.age_max").value == 0.0
+    # sidecar bytes counted with the canonical pricing
+    assert metrics.peek("bluefog.staleness.wire_bytes").value > 0
+
+
+def test_unsampled_steps_pay_nothing_and_share_programs():
+    """Interval sampling: only 1-in-N steps dispatch the lane; the
+    train-step cache keys are identical observatory on/off (the
+    bitwise-discipline structural pin)."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    ctx = bf.get_context()
+    opt, params, state, grads = _consensus_problem()
+    params, state = opt.step(params, state, grads)
+
+    def train_keys():
+        return {
+            k for k in ctx.op_cache
+            if isinstance(k, tuple) and k and k[0] == "opt_step"
+        }
+
+    keys_off = train_keys()
+    obs = staleness.start(interval=3)
+    for _ in range(6):
+        params, state = opt.step(params, state, grads)
+    assert train_keys() == keys_off
+    assert len(obs.samples) == 2  # 6 steps at interval 3
+    lane_keys = [
+        k for k in ctx.op_cache
+        if isinstance(k, tuple) and k and k[0] == "staleness_lane"
+    ]
+    assert len(lane_keys) == 1
+
+
+def test_delayed_path_age_one_with_reseed_transition():
+    """delayed=True steady state is age 1; a topology swap reseeds the
+    double buffer, so exactly one age-0 sample marks the seam."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    obs = staleness.start(interval=1)
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.0))
+    ts = opt.make_train_step(
+        lambda p, x: ((p["w"] - x) ** 2).mean(), delayed=True
+    )
+    params = {"w": bf.worker_values(
+        lambda r: np.random.RandomState(r).randn(600)
+        .astype(np.float32)
+    )}
+    state = opt.init(params)
+    x = bf.worker_values(lambda r: np.zeros(600, np.float32))
+    for _ in range(5):
+        params, state, _ = ts(params, state, x)
+    bf.set_topology(tu.ExponentialTwoGraph(SIZE))
+    for _ in range(3):
+        params, state, _ = ts(params, state, x)
+    ages = [s["age_mean"] for s in obs.samples]
+    surfaces = {s["surface"] for s in obs.samples}
+    assert surfaces == {"delayed"}
+    assert ages[0] == 0.0          # seed: buffer holds current params
+    assert ages[1:5] == [1.0] * 4  # steady state
+    assert ages[5] == 0.0          # swap reseed transition
+    assert ages[6:] == [1.0] * 2
+    assert all(s["lane_ok"] for s in obs.samples)
+
+
+def test_chaos_stall_hold_spikes_age_and_breach_names_edge(tmp_path):
+    """An injected per-edge stall (steps=3, peer-narrowed) ramps the
+    measured delivered age on exactly that edge; the breach advisory
+    names it on every PR-7 surface (metrics counter, flight side
+    table, JSONL)."""
+    jsonl = tmp_path / "staleness.jsonl"
+    os.environ["BLUEFOG_STALENESS_FILE"] = str(jsonl)
+    try:
+        bf.set_topology(tu.RingGraph(SIZE))
+        session = bf.elastic.start()
+        session.inject("stall", rank=2, step=2, steps=3, peer=3)
+        obs = staleness.start(interval=1, bound=2)
+        opt, params, state, grads = _consensus_problem(dim=600)
+        guard = bf.elastic.guard(opt)
+        for _ in range(8):
+            params, state = guard.step(params, state, grads)
+        spikes = [
+            s["age_max"] for s in obs.samples
+            if s.get("max_edge") == [2, 3]
+        ]
+        assert max(spikes) == 3.0  # the full injected hold
+        # only the injected edge ever aged
+        for edge, rec in obs.report()["edge_ages"].items():
+            if edge != "2->3":
+                assert rec["max"] == 0.0, edge
+        # lane self-check holds UNDER chaos: measured == expected
+        assert all(s["lane_ok"] for s in obs.samples)
+        breaches = [
+            a for a in obs.advisories if a.kind == "staleness_breach"
+        ]
+        assert len(breaches) == 1
+        detail = breaches[0].detail
+        assert detail["edges"] == [[2, 3]]
+        assert [2, 3] in detail["suspect_faults"]
+        # every surface: doctor counter, flight side table, JSONL
+        assert metrics.peek(
+            "bluefog.doctor.advisory.staleness_breach"
+        ).value == 1
+        table = flight._advisories
+        assert any(
+            a.get("kind") == "staleness_breach" for a in table
+        )
+        lines = [
+            json.loads(l) for l in jsonl.read_text().splitlines()
+        ]
+        assert any(l.get("kind") == "advisory" for l in lines)
+    finally:
+        os.environ.pop("BLUEFOG_STALENESS_FILE", None)
+
+
+def test_elastic_repair_resets_edge_age_state():
+    """A membership change (new live_token) must clear the per-edge
+    table: the repaired graph's edges are not the old graph's."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    session = bf.elastic.start(policy="average")
+    obs = staleness.start(interval=1)
+    opt, params, state, grads = _consensus_problem(dim=600)
+    guard = bf.elastic.guard(opt)
+    for _ in range(2):
+        params, state = guard.step(params, state, grads)
+    assert len(obs.edge_ages) == 2 * SIZE
+    session.inject("kill", rank=3, step=session.step)
+    for _ in range(2):
+        params, state = guard.step(params, state, grads)
+    # the dead rank's edges are gone from the fresh table
+    for s, d in obs.edge_ages:
+        assert 3 not in (s, d)
+    assert all(s["lane_ok"] for s in obs.samples)
+
+
+# -- window surface -----------------------------------------------------------
+
+
+def test_window_ages_fold_into_observatory():
+    bf.set_topology(tu.RingGraph(SIZE))
+    obs = staleness.start(interval=1)
+    x = bf.worker_values(lambda r: np.full(16, float(r), np.float32))
+    bf.win_create(x, "stalewin")
+    bf.win_put(name="stalewin")
+    bf.win_update(name="stalewin")
+    bf.win_update(name="stalewin")
+    win_samples = [
+        s for s in obs.samples if s.get("surface") == "window"
+    ]
+    assert len(win_samples) == 2
+    # buffers written by the put at clock 1: the first update consumes
+    # them the same local step (age 0); by the second update one more
+    # local step has passed with no rewrite (age 1)
+    assert win_samples[0]["age_max"] == 0.0
+    assert win_samples[1]["age_max"] == 1.0
+    assert metrics.peek("bluefog.staleness.window_age").count > 0
+    bf.win_free("stalewin")
+
+
+# -- health-plane integration -------------------------------------------------
+
+
+def test_age_adjusted_mixing_shrinks_residual_on_delayed_run():
+    """The acceptance-criterion pin: on a delayed=True pure-consensus
+    run, the age-corrected efficiency must sit strictly closer to 1.0
+    than the raw zero-staleness one."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    ctx = bf.get_context()
+    staleness.start(interval=1)
+    plane = health.HealthPlane(interval=1)
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.0))
+    ts = opt.make_train_step(
+        lambda p, x: ((p["w"] - x) ** 2).mean(), delayed=True
+    )
+    params = {"w": bf.worker_values(
+        lambda r: np.random.RandomState(r).randn(2048)
+        .astype(np.float32)
+    )}
+    state = opt.init(params)
+    x = bf.worker_values(lambda r: np.zeros(2048, np.float32))
+    last = None
+    for t in range(30):
+        params, state, _ = ts(params, state, x)
+        w = np.asarray(params["w"], np.float64)
+        d = float(np.sqrt(((w - w.mean(0)) ** 2).sum(1)).mean())
+        last = plane.observe(ctx, step=t, consensus=d)
+    eff = last["mixing_efficiency"]
+    eff_adj = last["mixing_efficiency_age_adjusted"]
+    assert last["age_mean"] == pytest.approx(1.0)
+    assert abs(eff_adj - 1.0) < abs(eff - 1.0)
+    assert last["age_adjusted_rate"] > last["predicted_rate"]
+    assert metrics.peek(
+        "bluefog.health.mixing_efficiency_age_adjusted"
+    ) is not None
+
+
+def test_fleet_lane_carries_stale_age_field():
+    """The per-rank max delivered age rides the PR-9 push-sum lane:
+    fleet min/mean/max over the new FLEET_FIELDS slot."""
+    assert "stale_age_max" in health.FLEET_FIELDS
+    idx = health.FLEET_FIELDS.index("stale_age_max")
+    bf.set_topology(tu.RingGraph(SIZE))
+    ctx = bf.get_context()
+    obs = staleness.start(interval=1)
+    obs._last_gossip_max = 3.0  # as if a stale edge was measured
+    plane = health.start(interval=1)
+    plane.observe(ctx, step=0, consensus=1.0)
+    fleet = plane.fleet
+    assert fleet is not None
+    assert fleet["fields"][idx] == "stale_age_max"
+    assert fleet["max"][idx] == pytest.approx(3.0, rel=0.05)
+
+
+# -- artifact + CLI -----------------------------------------------------------
+
+
+def test_dump_and_staleness_report_cli(tmp_path):
+    bf.set_topology(tu.RingGraph(SIZE))
+    session = bf.elastic.start()
+    session.inject("stall", rank=2, step=1, steps=3, peer=3)
+    obs = staleness.start(interval=1, bound=2)
+    opt, params, state, grads = _consensus_problem(dim=600)
+    guard = bf.elastic.guard(opt)
+    for _ in range(6):
+        params, state = guard.step(params, state, grads)
+    path = tmp_path / "staleness_dump.json"
+    assert bf.staleness.dump(str(path)) == str(path)
+    d = json.loads(path.read_text())
+    assert d["kind"] == "staleness_dump"
+    assert d["edge_ages"]["2->3"]["max"] == 3.0
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "staleness_report.py"),
+         str(path), "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["kind"] == "staleness_report"
+    assert rep["worst_edge"]["edge"] == "2->3"
+    assert rep["breaches"]
+    assert rep["lane_selfcheck_failures"] == 0
+
+
+def test_report_cli_exits_2_on_no_input(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "staleness_report.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+
+
+def test_export_dir_warning_fires_once():
+    """BLUEFOG_STALENESS_FILE pointing into a non-existent directory
+    warns exactly once (the BLUEFOG_LOG_LEVEL discipline), not once
+    per sample — and never raises."""
+    from bluefog_tpu import logging_util
+
+    logging_util._warned_once.clear()
+    fired = []
+    orig = logging_util.logger.warning
+    logging_util.logger.warning = lambda *a, **k: fired.append(a)
+    os.environ["BLUEFOG_STALENESS_FILE"] = (
+        "/nonexistent-bluefog-dir/staleness.jsonl"
+    )
+    try:
+        obs = staleness.StalenessObservatory(interval=1)
+        obs._export_line({"kind": "sample"})
+        obs._export_line({"kind": "sample"})
+        obs._export_line({"kind": "sample"})
+        assert len(fired) == 1
+        assert "BLUEFOG_STALENESS_FILE" in fired[0][1:][0]
+        keys = [
+            k for k in logging_util._warned_once
+            if "BLUEFOG_STALENESS_FILE" in k
+        ]
+        assert len(keys) == 1
+    finally:
+        logging_util.logger.warning = orig
+        os.environ.pop("BLUEFOG_STALENESS_FILE", None)
+
+
+def test_stall_fault_grammar_roundtrip():
+    """The chaos grammar's new stall fields parse and validate."""
+    from bluefog_tpu.elastic import parse_fault_plan
+
+    plan = parse_fault_plan("stall:rank=2,step=4,steps=6,peer=3")
+    f = plan.faults[0]
+    assert (f.kind, f.rank, f.step, f.hold_steps, f.peer) == (
+        "stall", 2, 4, 6, 3
+    )
+    with pytest.raises(ValueError):
+        parse_fault_plan("kill:rank=1,step=0,steps=5")
+    with pytest.raises(ValueError):
+        parse_fault_plan("kill:rank=1,step=0,peer=2")
+
+
+def test_two_windows_sample_independently():
+    """Per-window sampling clocks: with two windows updated alternately
+    at interval 2, BOTH get folded — a shared counter would alias the
+    modulo and starve one of them forever."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    obs = staleness.start(interval=2)
+    x = bf.worker_values(lambda r: np.full(8, float(r), np.float32))
+    bf.win_create(x, "alt_a")
+    bf.win_create(x, "alt_b")
+    for _ in range(4):
+        bf.win_update(name="alt_a")
+        bf.win_update(name="alt_b")
+    folded = {
+        s["window"] for s in obs.samples if s.get("surface") == "window"
+    }
+    assert folded == {"alt_a", "alt_b"}
+    bf.win_free()
+
+
+def test_second_edge_breach_not_muted_by_first():
+    """Per-(surface, edge) breach mutes: edge (2,3) breaching first
+    must not swallow edge (5,6)'s first breach a few samples later
+    (it would under a single shared cooldown); the same edge's
+    re-fires stay muted."""
+    bf.set_topology(tu.RingGraph(SIZE))
+    session = bf.elastic.start()
+    # edge (2,3) holds from step 1; edge (5,6) from step 3 — the
+    # second first-breach lands inside the first one's cooldown window
+    session.inject("stall", rank=2, step=1, steps=8, peer=3)
+    session.inject("stall", rank=5, step=3, steps=8, peer=6)
+    obs = staleness.start(interval=1, bound=2)
+    opt, params, state, grads = _consensus_problem(dim=600)
+    guard = bf.elastic.guard(opt)
+    for _ in range(10):
+        params, state = guard.step(params, state, grads)
+    named = [
+        tuple(e) for a in obs.advisories
+        if a.kind == "staleness_breach" for e in a.detail["edges"]
+    ]
+    assert (2, 3) in named and (5, 6) in named, named
+    # muting still rate-limits: each edge fired at most twice in 10
+    # samples (first crossing + possibly one post-cooldown re-fire)
+    assert named.count((2, 3)) <= 2 and named.count((5, 6)) <= 2
+
+
+def test_report_cli_jsonl_path_reports_breaches(tmp_path):
+    """Regression: JSONL stream lines carry kind='advisory' with the
+    real kind under 'advisory_kind' — the --jsonl triage path must
+    still surface the breach history."""
+    jsonl = tmp_path / "staleness.jsonl"
+    os.environ["BLUEFOG_STALENESS_FILE"] = str(jsonl)
+    try:
+        bf.set_topology(tu.RingGraph(SIZE))
+        session = bf.elastic.start()
+        session.inject("stall", rank=2, step=1, steps=3, peer=3)
+        obs = staleness.start(interval=1, bound=2)
+        opt, params, state, grads = _consensus_problem(dim=600)
+        guard = bf.elastic.guard(opt)
+        for _ in range(6):
+            params, state = guard.step(params, state, grads)
+        assert obs.advisories  # a breach definitely fired
+    finally:
+        os.environ.pop("BLUEFOG_STALENESS_FILE", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "staleness_report.py"),
+         "--jsonl", str(jsonl), "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["breaches"], "JSONL triage lost the breach history"
+    assert rep["breaches"][0]["edges"] == [[2, 3]]

@@ -1,0 +1,69 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Every ``tools/*.py`` CLI answers ``--help`` fast and exits 0.
+
+The tools are the operator surface of the observability stack; a tool
+whose ``--help`` initializes a jax backend (or worse, starts running)
+fails the 3 a.m. test. The jax-heavy profilers gate their CLI parse
+BEFORE the heavy imports, so this smoke test doubles as the
+lazy-import regression guard — the time bound is what pins it.
+"""
+
+import glob
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = sorted(
+    p for p in glob.glob(os.path.join(REPO, "tools", "*.py"))
+    if os.path.basename(p) != "__init__.py"
+)
+
+# Hard kill bound for the subprocess itself...
+HELP_TIMEOUT_S = 60.0
+# ...and the bound that actually pins the lazy-import discipline: an
+# argparse-before-jax --help is interpreter startup + argparse
+# (~0.15 s measured); a tool that re-grows a module-level `import jax`
+# (+ flax/optax + backend init) lands well past this even on a slow
+# CI host. Deliberately tighter than the subprocess timeout so a slow
+# (but not hung) regression FAILS instead of timing out vacuously.
+HELP_WALL_BOUND_S = 10.0
+
+
+@pytest.mark.parametrize(
+    "tool", TOOLS, ids=[os.path.basename(t) for t in TOOLS]
+)
+def test_tool_help_exits_zero(tool):
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, tool, "--help"],
+        capture_output=True, text=True, timeout=HELP_TIMEOUT_S,
+        cwd=REPO,
+    )
+    elapsed = time.perf_counter() - t0
+    assert proc.returncode == 0, (
+        f"{os.path.basename(tool)} --help exited "
+        f"{proc.returncode}: {proc.stderr[-400:]}"
+    )
+    assert proc.stdout.strip(), (
+        f"{os.path.basename(tool)} --help printed nothing"
+    )
+    assert elapsed < HELP_WALL_BOUND_S, (
+        f"{os.path.basename(tool)} --help took {elapsed:.1f}s — a "
+        "CLI gate probably slipped below a heavy import"
+    )
+
+
+def test_tools_enumerated():
+    """The glob found the expected operator surface (a rename that
+    drops a tool from the smoke test should be deliberate)."""
+    names = {os.path.basename(t) for t in TOOLS}
+    assert {
+        "bench_diff.py", "doctor.py", "fleet_report.py",
+        "metrics_report.py", "staleness_report.py", "trace_merge.py",
+        "hlo_overlap_scan.py", "hlo_dump.py", "perf_probe.py",
+        "resnet_layer_profile.py", "transformer_stage_profile.py",
+    } <= names

@@ -529,3 +529,91 @@ def test_window_optimizer_push_sum_quantized_wire(monkeypatch):
         c - c.mean(0)
     ).max()
     opt.free()
+
+
+# -- age lane (staleness observatory, docs/staleness.md) ----------------------
+
+
+def test_get_win_version_age_semantics_oracle():
+    """Oracle for the age lane across win_put -> win_update cycles: the
+    version counter resets at every update, but the AGE (local window
+    steps since the slot's last write) keeps counting from the write —
+    the question ``get_win_version(ages=True)`` exists to answer."""
+    x = ranks_tensor()
+    bf.win_create(x, "agew")
+    in_nbrs = bf.get_context().in_neighbor_ranks()
+
+    # fresh window: buffers are copies of the creating value, age 0
+    for r in range(SIZE):
+        assert bf.get_win_age("agew", rank=r) == {
+            s: 0 for s in in_nbrs[r]
+        }
+
+    # numpy oracle replayed against the same op sequence: clock
+    # advances per op; a put stamps every written slot
+    expected_age = {r: {s: 0 for s in in_nbrs[r]} for r in range(SIZE)}
+
+    def tick(written: bool):
+        for r in range(SIZE):
+            for s in expected_age[r]:
+                expected_age[r][s] = (
+                    0 if written else expected_age[r][s] + 1
+                )
+
+    for cycle in range(3):
+        bf.win_put(name="agew")
+        tick(written=True)
+        assert bf.get_win_version("agew", ages=True) == [
+            expected_age[r] for r in range(SIZE)
+        ]
+        # two updates in a row: version resets to 0 both times, the
+        # age keeps growing — the two lanes answer different questions
+        for _ in range(2):
+            bf.win_update(name="agew")
+            tick(written=False)
+            vers = bf.get_win_version("agew")
+            assert all(
+                v == 0 for row in vers for v in row.values()
+            )
+            assert bf.get_win_age("agew") == [
+                expected_age[r] for r in range(SIZE)
+            ]
+    bf.win_free("agew")
+
+
+def test_win_age_mass_lane_tracks_oldest_pending_accumulate():
+    """Push-sum mass age: the oldest uncollected win_accumulate mass
+    per slot, cleared by the collecting (resetting) update — mass
+    conservation and mass staleness jointly visible."""
+    bf.turn_on_win_ops_with_associated_p()
+    x = ranks_tensor()
+    bf.win_create(x, "massw", zero_init=True)
+    in_nbrs = bf.get_context().in_neighbor_ranks()
+
+    # nothing pending before any accumulate
+    for r in range(SIZE):
+        assert all(
+            v is None
+            for v in bf.get_win_age("massw", rank=r, mass=True).values()
+        )
+    bf.win_accumulate(name="massw")
+    for r in range(SIZE):
+        assert bf.get_win_age("massw", rank=r, mass=True) == {
+            s: 0 for s in in_nbrs[r]
+        }
+    # a second accumulate does NOT refresh the mass birth: the slot
+    # holds mass from BOTH, and its age is the oldest contribution's
+    bf.win_accumulate(name="massw")
+    for r in range(SIZE):
+        assert bf.get_win_age("massw", rank=r, mass=True) == {
+            s: 1 for s in in_nbrs[r]
+        }
+    # the collect consumes the mass: nothing pending again
+    bf.win_update_then_collect("massw")
+    for r in range(SIZE):
+        assert all(
+            v is None
+            for v in bf.get_win_age("massw", rank=r, mass=True).values()
+        )
+    bf.win_free("massw")
+    bf.turn_off_win_ops_with_associated_p()

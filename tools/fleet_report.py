@@ -30,7 +30,11 @@ FIELD_CONSENSUS = 1  # index of "consensus" in health.FLEET_FIELDS
 
 
 def fetch_endpoint(hostport: str, timeout: float = 5.0) -> dict:
-    """GET ``/fleet`` from one rank's health endpoint."""
+    """GET ``/fleet`` from one rank's health endpoint. ``timeout``
+    bounds BOTH the connect and the read (socket-level), so one dead
+    rank can stall this scrape by at most ``timeout`` seconds — the
+    fleet table then degrades to a partial table with that rank marked
+    unreachable instead of aborting."""
     import urllib.request
 
     url = f"http://{hostport.strip()}/fleet"
@@ -97,7 +101,15 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
     fleet = None
     for src, d in zip(sources, dumps):
         if d.get("unreadable"):
-            rows.append({"source": src, "unreadable": True})
+            # a dead/unreachable rank degrades to a marked row, never
+            # an aborted table — the operator needs to see WHICH rank
+            # is dark, alongside the live ones
+            rows.append({
+                "source": src,
+                "status": "unreachable",
+                "unreadable": True,
+                "error": d.get("error"),
+            })
             continue
         last = d.get("last_sample") or {}
         hz = d.get("healthz") or {}
@@ -108,6 +120,10 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
             "step_ms_ewma": last.get("step_ms_ewma"),
             "consensus": last.get("consensus"),
             "mixing_efficiency": last.get("mixing_efficiency"),
+            "mixing_efficiency_age_adjusted": last.get(
+                "mixing_efficiency_age_adjusted"
+            ),
+            "stale_age_mean": last.get("age_mean"),
             "predicted_rate": last.get("predicted_rate"),
             "measured_rate": last.get("measured_rate"),
             "time_to_eps_steps": last.get("time_to_eps_steps"),
@@ -127,9 +143,14 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
     fleet_block = fleet[1] if fleet else None
     worst = worst_rank(fleet_block)
     statuses = [r.get("status") for r in rows if not r.get("unreadable")]
+    unreachable = sum(1 for r in rows if r.get("unreadable"))
     overall = (
         "critical" if "critical" in statuses
-        else "warn" if "warn" in statuses
+        # ANY dark rank is at least a warning: the live rows may all
+        # read ok precisely because the sick rank is the one not
+        # answering — and a fleet-wide outage (every rank dark) must
+        # not read as the same 'unknown' an empty input would
+        else "warn" if "warn" in statuses or unreachable
         else "ok" if statuses else "unknown"
     )
     return {
@@ -150,6 +171,11 @@ def main(argv=None) -> int:
     ap.add_argument("--endpoints",
                     help="comma-separated host:port list to scrape "
                          "live /fleet from")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint connect/read timeout in seconds "
+                         "(default 5.0); a rank that cannot answer "
+                         "within it is marked unreachable and the "
+                         "table degrades to the ranks that can")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as one JSON object")
     args = ap.parse_args(argv)
@@ -169,10 +195,10 @@ def main(argv=None) -> int:
             continue
         sources.append(hp)
         try:
-            dumps.append(fetch_endpoint(hp))
+            dumps.append(fetch_endpoint(hp, timeout=args.timeout))
         except Exception as e:
             print(f"warning: {hp}: {e}", file=sys.stderr)
-            dumps.append({"unreadable": True})
+            dumps.append({"unreadable": True, "error": str(e)[:200]})
     if not dumps:
         print("no artifacts or endpoints given", file=sys.stderr)
         return 2
@@ -193,7 +219,8 @@ def main(argv=None) -> int:
             "mixing_efficiency", "advisories", "dominant_advisory")
     for r in report["processes"]:
         if r.get("unreadable"):
-            print(f"  {r['source']}: unreadable")
+            err = f" ({r['error']})" if r.get("error") else ""
+            print(f"  {r['source']}: UNREACHABLE{err}")
             continue
         print("  " + "  ".join(
             f"{c}={r.get(c)}" for c in cols if r.get(c) is not None

@@ -11,6 +11,17 @@ import re
 import sys
 from collections import Counter
 
+if __name__ == "__main__":
+    # CLI gate BEFORE the jax import: --help must answer in
+    # milliseconds (and exit 0), not after a backend initializes.
+    # Configuration is env-driven (PROBE_BATCH).
+    import argparse
+
+    argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="configuration: PROBE_BATCH (batch size, default 128)",
+    ).parse_args()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np

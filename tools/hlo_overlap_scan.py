@@ -217,12 +217,14 @@ def scan_overlap(hlo_text: str) -> dict:
 
 
 def main() -> int:
-    if len(sys.argv) != 2:
-        print("usage: hlo_overlap_scan.py <hlo-text-file|->", file=sys.stderr)
-        return 2
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("hlo", help="optimized HLO text file, or - for stdin")
+    args = ap.parse_args()
     text = (
-        sys.stdin.read() if sys.argv[1] == "-"
-        else open(sys.argv[1]).read()
+        sys.stdin.read() if args.hlo == "-"
+        else open(args.hlo).read()
     )
     result = scan_overlap(text)
     # the per-permute list can be large; summarize on the CLI

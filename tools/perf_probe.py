@@ -18,6 +18,18 @@ import os
 import sys
 import time
 
+if __name__ == "__main__":
+    # CLI gate BEFORE the jax import: --help must answer in
+    # milliseconds (and exit 0), not after a backend initializes.
+    # Probe selection is env-driven (PROBE=matmul,dispatch,...).
+    import argparse
+
+    argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="configuration: PROBE (comma-separated subset of "
+               "matmul,dispatch,resnet,fwd), PROBE_BATCH",
+    ).parse_args()
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np

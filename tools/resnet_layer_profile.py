@@ -18,6 +18,16 @@ docs/performance.md.
 
 import json
 
+if __name__ == "__main__":
+    # CLI gate BEFORE the jax import: --help must answer in
+    # milliseconds (and exit 0), not after a backend initializes.
+    import argparse
+
+    argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="configuration: PROFILE_STEPS, PROFILE_WINDOWS",
+    ).parse_args()
+
 import numpy as np
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Triage a staleness-observatory artifact into the table an operator
+reads first.
+
+The staleness observatory (:mod:`bluefog_tpu.staleness`,
+docs/staleness.md) leaves one artifact per controller process —
+``bf.staleness.dump(path)`` JSON and/or the ``BLUEFOG_STALENESS_FILE``
+JSONL — carrying per-edge delivered-age samples, the window-surface
+ages, and every ``staleness_breach`` advisory. This tool joins them
+into: the per-edge age table (last / max / samples), the worst edge,
+the surface breakdown (sync / delayed / window), and the breach history
+with its chaos-fault suspects.
+
+Usage::
+
+    python tools/staleness_report.py staleness_dump.json
+    python tools/staleness_report.py --jsonl staleness.jsonl
+    python tools/staleness_report.py ... --json
+
+No jax import, no live mesh needed. Exit status 0 on a parseable input
+set, 2 when nothing could be read.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("kind") != "staleness_dump":
+        raise ValueError(
+            f"{path} is not a staleness artifact (expected kind="
+            f"'staleness_dump', got {d.get('kind')!r})"
+        )
+    return d
+
+
+def load_jsonl(path: str) -> dict:
+    """Rebuild a dump-shaped dict from the BLUEFOG_STALENESS_FILE
+    stream (samples + advisories, one JSON object per line)."""
+    samples: List[dict] = []
+    advisories: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("kind") == "sample":
+                samples.append(obj)
+            elif obj.get("kind") == "advisory":
+                advisories.append(obj)
+    edge_ages: dict = {}
+    for s in samples:
+        e = s.get("max_edge")
+        if e is None:
+            continue
+        key = f"{e[0]}->{e[1]}"
+        rec = edge_ages.setdefault(key, {"last": 0.0, "max": 0.0, "n": 0})
+        rec["last"] = float(s.get("age_max", 0.0))
+        rec["max"] = max(rec["max"], float(s.get("age_max", 0.0)))
+        rec["n"] += 1
+    return {
+        "kind": "staleness_dump",
+        "samples": samples,
+        "advisories": advisories,
+        "edge_ages": edge_ages,
+        "comm_steps": max(
+            (s.get("comm_steps", 0) for s in samples), default=0
+        ),
+    }
+
+
+def build_report(dump: dict) -> dict:
+    samples = dump.get("samples") or []
+    advisories = dump.get("advisories") or []
+    edge_ages = dump.get("edge_ages") or {}
+    surfaces: dict = {}
+    lane_failures = 0
+    for s in samples:
+        surf = s.get("surface", "?")
+        rec = surfaces.setdefault(
+            surf, {"samples": 0, "age_max": 0.0, "age_mean_last": None}
+        )
+        rec["samples"] += 1
+        rec["age_max"] = max(rec["age_max"], float(s.get("age_max", 0.0)))
+        rec["age_mean_last"] = s.get("age_mean")
+        if s.get("lane_ok") is False:
+            lane_failures += 1
+    worst = None
+    for edge, rec in edge_ages.items():
+        if worst is None or rec["max"] > worst[1]["max"]:
+            worst = (edge, rec)
+    # dump-file advisories carry kind='staleness_breach' at top level
+    # (Advisory.to_json); JSONL stream lines carry kind='advisory' with
+    # the real kind under 'advisory_kind' — check that one FIRST
+    breaches = [
+        a for a in advisories
+        if (a.get("advisory_kind") or a.get("kind"))
+        == "staleness_breach"
+    ]
+    return {
+        "kind": "staleness_report",
+        "comm_steps": dump.get("comm_steps"),
+        "interval": dump.get("interval"),
+        "bound": dump.get("bound"),
+        "surfaces": surfaces,
+        "edge_ages": edge_ages,
+        "worst_edge": (
+            {"edge": worst[0], **worst[1]} if worst else None
+        ),
+        "breaches": breaches,
+        "lane_selfcheck_failures": lane_failures,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="staleness artifact JSON files "
+                         "(bf.staleness.dump output)")
+    ap.add_argument("--jsonl",
+                    help="BLUEFOG_STALENESS_FILE stream to rebuild a "
+                         "report from")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    dumps: List[dict] = []
+    for p in args.artifacts:
+        try:
+            dumps.append(load_artifact(p))
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if args.jsonl:
+        try:
+            dumps.append(load_jsonl(args.jsonl))
+        except OSError as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if not dumps:
+        print("no readable staleness artifacts given", file=sys.stderr)
+        return 2
+
+    # merge multiple processes' dumps into one view (edge tables union,
+    # max wins; surfaces summed)
+    merged: Optional[dict] = None
+    for d in dumps:
+        if merged is None:
+            merged = dict(d)
+            merged["samples"] = list(d.get("samples") or [])
+            merged["advisories"] = list(d.get("advisories") or [])
+            merged["edge_ages"] = dict(d.get("edge_ages") or {})
+            continue
+        merged["samples"] += d.get("samples") or []
+        merged["advisories"] += d.get("advisories") or []
+        for e, rec in (d.get("edge_ages") or {}).items():
+            cur = merged["edge_ages"].get(e)
+            if cur is None:
+                merged["edge_ages"][e] = dict(rec)
+            else:
+                cur["max"] = max(cur["max"], rec["max"])
+                cur["last"] = rec["last"]
+                cur["n"] += rec["n"]
+    report = build_report(merged)
+
+    if args.json:
+        print(json.dumps(report))
+        return 0
+
+    print(f"staleness: {report['comm_steps']} comm steps observed, "
+          f"bound {report.get('bound')}, "
+          f"{len(report['breaches'])} breach(es), "
+          f"{report['lane_selfcheck_failures']} lane self-check "
+          f"failure(s)")
+    for surf, rec in sorted(report["surfaces"].items()):
+        print(f"  surface {surf:<8} samples {rec['samples']:>5}  "
+              f"age_max {rec['age_max']:g}  "
+              f"last mean {rec['age_mean_last']}")
+    ages = sorted(
+        report["edge_ages"].items(),
+        key=lambda kv: -kv[1]["max"],
+    )
+    if ages:
+        print("per-edge delivered age (worst first):")
+        for edge, rec in ages[:16]:
+            print(f"  {edge:<10} last {rec['last']:>6g}  "
+                  f"max {rec['max']:>6g}  samples {rec['n']}")
+        if len(ages) > 16:
+            print(f"  ... {len(ages) - 16} more edges")
+    worst = report.get("worst_edge")
+    if worst:
+        sentence = (
+            f"worst edge: {worst['edge']} (max delivered age "
+            f"{worst['max']:g})"
+        )
+        suspects = [
+            a.get("suspect_faults") for a in report["breaches"]
+            if a.get("suspect_faults")
+        ]
+        if suspects:
+            sentence += f"; chaos suspects at breach time: {suspects[0]}"
+        print(sentence)
+    for a in report["breaches"][:4]:
+        print(f"breach @step {a.get('step')}: edges {a.get('edges')} "
+              f"ages {a.get('ages')} bound {a.get('bound')} "
+              f"surface {a.get('surface')}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -24,6 +24,16 @@ import json
 import os
 import sys
 
+if __name__ == "__main__":
+    # CLI gate BEFORE the jax import: --help must answer in
+    # milliseconds (and exit 0), not after a backend initializes.
+    import argparse
+
+    argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="configuration: PROFILE_STEPS, PROFILE_WINDOWS",
+    ).parse_args()
+
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
