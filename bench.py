@@ -74,6 +74,17 @@ Individual families via ``BENCH_MODE``:
   structural + bitwise pins), and a deterministic per-edge stall chaos
   scenario whose measured age spike and ``staleness_breach`` advisory
   must name the injected edge. Committed as STALENESS_EVIDENCE.json.
+- ``autotune``: closed-loop topology-controller evidence
+  (``bf.autotune``, docs/autotune.md) — an injected degraded link is
+  detected through the real doctor advisory stream, routed around by a
+  live migration through the elastic repair path (decision record
+  naming the edge, measured wire cost + mixing efficiency recovering
+  past gated thresholds), with the ≤1 % overhead bound at the default
+  interval (A/A control, structural + bitwise pins), a dry-run pass
+  recording full decision history with zero migrations, and the audit
+  trail round-tripped through every surface (metrics, flight side
+  table, JSONL, ``tools/autotune_report.py``). Committed as
+  AUTOTUNE_EVIDENCE.json.
 - ``quant``: quantized-wire evidence — every wire tier
   (fp32/bf16/int8/int8_ef/int4/int4_ef) on one pure-consensus problem,
   per-tier wire bytes with the block-scale sidecar priced in,
@@ -3050,6 +3061,534 @@ def run_staleness() -> int:
     return 0
 
 
+def run_autotune() -> int:
+    """Closed-loop controller evidence (``BENCH_MODE=autotune``,
+    committed as AUTOTUNE_EVIDENCE.json). Four claims, each measured
+    the way it is resolvable (the metrics/health noise-floor lessons
+    apply):
+
+    1. **The loop closes on real telemetry** (``autotune_chaos``): a
+       per-edge degrade fault slows the attribution doctor's probe
+       dispatches deterministically; the ``degraded_link`` advisory
+       names the edge from timings alone; the controller harvests it,
+       searches, and migrates the LIVE guarded optimizer through the
+       elastic repair path — the decision record names the edge in its
+       trigger set, the installed matrix excludes (or down-weights)
+       it, zero stale dispatches, and the doctor's own measured wire
+       cost collapses back to the healthy level after the swap.
+    2. **Mixing efficiency recovers** (``autotune_mixing_recovery``):
+       the deterministic lossy-link consensus replay (the
+       ``BENCH_MODE=health`` chaos model) degrades measured mixing
+       below the spectral promise; ``mixing_degraded`` fires naming
+       the edge; the controller routes around it and the measured
+       efficiency (and the chaos-priced simulated step time, pinned
+       calibration disclosed) recover past the gated thresholds. The
+       same scenario re-run under ``dry_run`` records the full
+       decision history with ZERO migrations (``autotune_dry_run``),
+       and its audit trail round-trips through every surface —
+       metrics, flight side table, JSONL,
+       ``tools/autotune_report.py`` reconstruction, the health /fleet
+       block (``autotune_audit``).
+    3. **Overhead <= 1 % at the default interval**
+       (``autotune_overhead``): controller-on (sampling every step,
+       quiescent fabric) vs controller-off in a step-level all-
+       orderings rotation, amortized over the default interval, with
+       an off/off A/A control. Structural pin: enabling the
+       controller adds no train-step cache entry; bitwise pin:
+       controller-on/off training state identical to the bit (the
+       controller never touches the dispatched program; only a
+       migration bumps the topology version, and a quiescent fabric
+       never migrates).
+    """
+    if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
+        from bluefog_tpu.platforms import ensure_cpu_device_count
+
+        ensure_cpu_device_count(
+            int(os.environ.get("BENCH_AUTOTUNE_DEVICES", "8"))
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import itertools
+    import tempfile
+    import time as time_mod
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+    import bluefog_tpu.topology as topo
+    from bluefog_tpu import attribution
+    from bluefog_tpu import autotune
+    from bluefog_tpu import flight as flight_mod
+    from bluefog_tpu import health
+    from bluefog_tpu import metrics as bf_metrics
+    from bluefog_tpu.collective import compiler
+
+    devices = jax.devices()
+    n = min(len(devices),
+            int(os.environ.get("BENCH_AUTOTUNE_WORKERS", "8")))
+    dim = int(os.environ.get("BENCH_AUTOTUNE_DIM", "256"))
+    layers = int(os.environ.get("BENCH_AUTOTUNE_LAYERS", "6"))
+    batch = int(os.environ.get("BENCH_AUTOTUNE_BATCH", "16"))
+    samples = max(18, int(os.environ.get("BENCH_AUTOTUNE_SAMPLES",
+                                         "60")))
+
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("BLUEFOG_AUTOTUNE", "BLUEFOG_AUTOTUNE_INTERVAL",
+                  "BLUEFOG_AUTOTUNE_FILE", "BLUEFOG_AUTOTUNE_DRY_RUN",
+                  "BLUEFOG_AUTOTUNE_COOLDOWN", "BLUEFOG_AUTOTUNE_WIRE",
+                  "BLUEFOG_DOCTOR", "BLUEFOG_HEALTH",
+                  "BLUEFOG_METRICS")
+    }
+    for k in old_env:
+        os.environ.pop(k, None)
+    default_interval = autotune.autotune_interval()
+    rng = np.random.RandomState(0)
+
+    # -- claim 1: the loop closes on real doctor telemetry -------------------
+    bf.init(devices=devices[:n])
+    ctx = bf.get_context()
+    bf.set_topology(topo.RingGraph(n))
+    compiler.calibrate()
+    kill_src = int(os.environ.get("BENCH_AUTOTUNE_DEGRADE_RANK", "2"))
+    kill_dst = (kill_src + 1) % n
+    factor = 0.05
+    session = bf.elastic.start(policy="average")
+    session.inject("degrade", rank=kill_src, step=0, factor=factor,
+                   peer=kill_dst)
+    # doctor at interval 1: every step probes, so an occasional
+    # blame-free sample under ambient load cannot open a quiet gap
+    # long enough to reset the controller's trigger streak
+    doc = attribution.start(interval=1)
+    # the controller is driven explicitly with a PINNED step clock for
+    # its verification channel (an ambient-load spike on the shared
+    # host would otherwise roll a good migration back — guardrail
+    # working as designed, noise this evidence must not depend on);
+    # the measured step-time recovery channel below is the doctor's
+    # probe-measured wire cost, which IS wall clock
+    tuner = autotune.TopologyAutotuner(interval=1, cooldown=8)
+    opt = bf.DistributedAdaptThenCombineOptimizer(optax.sgd(0.05))
+    guard = bf.elastic.guard(opt)
+    params = {"w": bf.worker_values(
+        lambda r: rng.randn(4096).astype(np.float32)
+    )}
+    state = opt.init(params)
+    zeros = {"w": bf.worker_values(np.zeros(4096, np.float32))}
+    w_before = topo.mixing_matrix(bf.load_topology()).copy()
+    for _t in range(14):
+        params, state = guard.step(params, state, zeros)
+        tuner.observe(ctx, step=_t, optimizer=opt, step_s=0.01)
+    named = sorted({
+        tuple(a.detail["edge"]) for a in doc.advisories
+        if a.kind == "degraded_link" and a.detail.get("edge")
+    })
+    detected = (kill_src, kill_dst) in named
+    swap = next(
+        (d for d in tuner.decisions if d.action == "swap"), None
+    )
+    trigger_names_edge = bool(swap) and any(
+        t.get("edge") == [kill_src, kill_dst] for t in swap.triggers
+    )
+    w_after = topo.mixing_matrix(bf.load_topology())
+    migrated_excludes = bool(
+        w_after[kill_src, kill_dst] < w_before[kill_src, kill_dst]
+    )
+    wire_series = [
+        s["comm_wire_ms"] for s in doc.samples
+        if s.get("comm_wire_ms") is not None
+    ]
+    wire_degraded = max(wire_series[:2], default=0.0)
+    wire_recovered = min(wire_series[-2:], default=0.0)
+    wire_ratio = (
+        wire_degraded / wire_recovered if wire_recovered > 0 else None
+    )
+    finite = bool(np.all(np.isfinite(np.asarray(params["w"]))))
+    chaos_line = {
+        "metric": "autotune_chaos",
+        "n_workers": n,
+        "injected_edge": [kill_src, kill_dst],
+        "degrade_factor": factor,
+        "detected_by_doctor": detected,
+        "edges_named": [list(e) for e in named],
+        "decision_action": swap.action if swap else None,
+        "chosen": swap.chosen if swap else None,
+        "trigger_names_edge": trigger_names_edge,
+        "predicted_gain_frac": (
+            swap.predicted.get("gain_frac") if swap else None
+        ),
+        "migrated_excludes_edge": migrated_excludes,
+        "edge_weight_before": round(
+            float(w_before[kill_src, kill_dst]), 6
+        ),
+        "edge_weight_after": round(
+            float(w_after[kill_src, kill_dst]), 6
+        ),
+        "comm_wire_degraded_ms": round(wire_degraded, 4),
+        "comm_wire_recovered_ms": round(wire_recovered, 4),
+        "comm_wire_recovery_ratio": (
+            round(wire_ratio, 2) if wire_ratio else None
+        ),
+        "stale_dispatches": session.stale_dispatches,
+        "training_state_finite": finite,
+    }
+    print(json.dumps(chaos_line))
+    autotune.stop()
+    attribution.stop()
+    bf.elastic.stop()
+    bf.shutdown()
+
+    # -- claim 2: mixing recovery + dry run + audit trail --------------------
+    # Deterministic host replay of the lossy link (the BENCH_MODE=health
+    # chaos model) with a PINNED calibration so the chaos-priced
+    # simulated step times are identical run to run (disclosed: the
+    # step-time channel here is the chaos pricing, not a wall clock —
+    # claim 1 carries the measured-wall-clock recovery).
+    compiler.set_calibration(1e-4, 1e9, source="pinned-sim")
+    tmp_dir = tempfile.mkdtemp(prefix="bf_autotune_bench_")
+    jsonl_path = os.path.join(tmp_dir, "autotune.jsonl")
+
+    def run_sim(dry_run):
+        bf.init(devices=devices[:n])
+        ctx = bf.get_context()
+        bf.set_topology(topo.RingGraph(n))
+        session = bf.elastic.start(policy="average")
+        healthy_steps = 30
+        session.inject("degrade", rank=kill_src, step=healthy_steps,
+                       factor=factor, peer=kill_dst)
+        plane = health.start(interval=1)
+        tuner = autotune.start(interval=1, cooldown=8,
+                               dry_run=dry_run)
+        v0 = ctx.topo_version
+        x = rng.randn(n, 64)
+        B = compiler.DEFAULT_PAYLOAD_BYTES
+        last_v = ctx.topo_version
+        sim_ms = []
+        for t in range(130):
+            session.before_dispatch(None)
+            if ctx.topo_version != last_v:
+                last_v = ctx.topo_version
+                x = rng.randn(n, 64)  # fresh signal for the new
+                # graph's decay fit (the old series hit the fp floor)
+            w = topo.mixing_matrix(bf.load_topology())
+            y = w.T @ x
+            for key, f in session.simulated_wire_factors().items():
+                if isinstance(key, tuple):
+                    s, d = key
+                    if w[s, d] != 0.0:
+                        y[d] += (1.0 - f) * w[s, d] * (x[d] - x[s])
+            x = y
+            dist = float(np.sqrt(((x - x.mean(0)) ** 2).sum(1)).mean())
+            plane.observe(ctx, step=t, consensus=dist)
+            pen = sum(
+                compiler.degraded_round_penalty_s(B, f)
+                for key, f in
+                session.simulated_wire_factors().items()
+                if isinstance(key, tuple)
+                and w[key[0], key[1]] != 0.0
+            )
+            sim_ms.append((0.010 + pen) * 1e3)
+            tuner.observe(ctx, step=t, step_s=0.010 + pen)
+        return ctx, plane, tuner, sim_ms, v0
+
+    os.environ["BLUEFOG_AUTOTUNE_FILE"] = jsonl_path
+    ctx, plane, tuner, sim_ms, _v0 = run_sim(dry_run=False)
+    mix_advs = [
+        a for a in plane.advisories if a.kind == "mixing_degraded"
+    ]
+    adv_named = sorted({
+        tuple(e) for a in mix_advs
+        for e in a.detail.get("suspect_edges", [])
+        if isinstance(e, list)
+    })
+    swap2 = next(
+        (d for d in tuner.decisions if d.action == "swap"), None
+    )
+    eff_degraded = (
+        mix_advs[0].detail.get("mixing_efficiency") if mix_advs
+        else None
+    )
+    eff_baseline = (
+        mix_advs[0].detail.get("baseline_efficiency") if mix_advs
+        else None
+    )
+    rec_effs = [
+        s["mixing_efficiency"] for s in plane.samples
+        if s.get("mixing_efficiency") is not None
+        and swap2 is not None and s["step"] > swap2.step + 5
+    ]
+    eff_recovered = rec_effs[-1] if rec_effs else None
+    w_final = topo.mixing_matrix(bf.load_topology())
+    step_degraded_ms = max(sim_ms)
+    step_recovered_ms = sim_ms[-1]
+    recovery_line = {
+        "metric": "autotune_mixing_recovery",
+        "n_workers": n,
+        "injected_edge": [kill_src, kill_dst],
+        "degrade_factor": factor,
+        "advisory_fired": bool(mix_advs),
+        "advisory_names_edge": (kill_src, kill_dst) in adv_named,
+        "decision_action": swap2.action if swap2 else None,
+        "chosen": swap2.chosen if swap2 else None,
+        "efficiency_baseline": eff_baseline,
+        "efficiency_degraded": eff_degraded,
+        "efficiency_recovered": eff_recovered,
+        "sim_step_degraded_ms": round(step_degraded_ms, 3),
+        "sim_step_recovered_ms": round(step_recovered_ms, 3),
+        "recovered_step_ratio": round(
+            step_degraded_ms / max(step_recovered_ms, 1e-9), 2
+        ),
+        "migrated_excludes_edge": bool(
+            w_final[kill_src, kill_dst] == 0.0
+        ),
+        "calibration": "pinned (alpha=1e-4s, beta=1e9B/s) — the "
+                       "simulated step-time channel is the chaos "
+                       "pricing, disclosed",
+    }
+    print(json.dumps(recovery_line))
+
+    # audit trail: every surface carries the decision
+    snap = bf_metrics.snapshot()
+    dump = flight_mod._build_dump("bench")
+    from tools.autotune_report import build_report
+
+    dump_path = os.path.join(tmp_dir, "autotune_dump.json")
+    tuner.dump(dump_path)
+    recon_dump = build_report([dump_path])
+    recon_jsonl = build_report([jsonl_path])
+    fleet_block = plane.report().get("autotune") or {}
+    audit_line = {
+        "metric": "autotune_audit",
+        "decisions": len(tuner.decisions),
+        "metrics_decisions": snap.get(
+            "bluefog.autotune.decisions", {}
+        ).get("value"),
+        "flight_side_table_has_swap": any(
+            d.get("action") == "swap"
+            for d in dump.get("autotune_decisions", [])
+        ),
+        "jsonl_reconstruction_matches": (
+            recon_jsonl["decisions"] == len(tuner.decisions)
+        ),
+        "dump_reconstruction_matches": (
+            recon_dump["decisions"] == len(tuner.decisions)
+        ),
+        "report_joins_verification": any(
+            h.get("verification") is not None
+            for h in recon_dump["history"]
+            if h.get("action") == "swap"
+        ),
+        "fleet_block": fleet_block,
+    }
+    print(json.dumps(audit_line))
+    os.environ.pop("BLUEFOG_AUTOTUNE_FILE", None)
+    autotune.stop()
+    health.stop()
+    bf.elastic.stop()
+    bf.shutdown()
+
+    # dry run: same condition, full history, zero migrations
+    ctx, plane, tuner_dry, _sim, v0 = run_sim(dry_run=True)
+    v_end = ctx.topo_version
+    dry_line = {
+        "metric": "autotune_dry_run",
+        "decisions": len(tuner_dry.decisions),
+        "actions": sorted({
+            d.action for d in tuner_dry.decisions
+        }),
+        "swaps": tuner_dry.swaps,
+        "migrations_zero": bool(
+            tuner_dry.swaps == 0 and v_end == v0
+        ),
+        "topo_version_end": v_end,
+        "candidates_recorded": bool(
+            tuner_dry.decisions
+            and tuner_dry.decisions[0].candidates
+        ),
+    }
+    print(json.dumps(dry_line))
+    autotune.stop()
+    health.stop()
+    bf.elastic.stop()
+    bf.shutdown()
+    compiler.clear_calibration()
+
+    # -- claim 3: overhead / structural / bitwise pins -----------------------
+    bf.init(devices=devices[:n])
+    ctx = bf.get_context()
+    w0 = [
+        (rng.randn(dim, dim) / np.sqrt(dim)).astype(np.float32)
+        for _ in range(layers)
+    ]
+    xs_b = bf.worker_values(
+        lambda r: rng.randn(batch, dim).astype(np.float32)
+    )
+    ys_b = bf.worker_values(
+        lambda r: rng.randn(batch, dim).astype(np.float32)
+    )
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def make_stepper():
+        opt = bf.DistributedNeighborAllreduceOptimizer(
+            optax.sgd(0.01, momentum=0.9)
+        )
+        train_step = bf.make_train_step(opt, loss_fn)
+        params = {
+            f"w{i}": bf.worker_values(lambda r, i=i: w0[i])
+            for i in range(layers)
+        }
+        carry = [(params, opt.init(params))]
+
+        def _step():
+            p, s = carry[0]
+            p, s, loss = train_step(p, s, xs_b, ys_b)
+            carry[0] = (p, s)
+            return loss
+
+        return _step, carry
+
+    # structural pin: enabling the controller adds no cache entry at all
+    autotune.stop()
+    stepper, _carry = make_stepper()
+    stepper()
+    stepper()
+    keys_off = set(ctx.op_cache)
+    autotune.start(interval=1)
+    stepper()
+    stepper()
+    keys_on = set(ctx.op_cache)
+    unsampled_shared = keys_on == keys_off
+    autotune.stop()
+
+    # bitwise trajectory pin
+    state_bits = {}
+    for variant in ("off", "on"):
+        if variant == "on":
+            autotune.start(interval=3)
+        else:
+            autotune.stop()
+        _step, carry = make_stepper()
+        for _ in range(12):
+            _step()
+        state_bits[variant] = jax.tree_util.tree_leaves(carry[0])
+    autotune.stop()
+    bitwise = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(state_bits["off"], state_bits["on"])
+    )
+
+    # overhead at the default interval, all-orderings rotation + A/A
+    steppers = {}
+    tuner_on = autotune.TopologyAutotuner(interval=1)
+    for variant in ("off", "on", "off2"):
+        autotune.activate(tuner_on if variant == "on" else None)
+        steppers[variant], _ = make_stepper()
+        steppers[variant]()
+        _settle(steppers[variant]())
+    orders = list(itertools.permutations(("off", "on", "off2")))
+    times = {v: [] for v in steppers}
+    for i in range(samples):
+        for variant in orders[i % len(orders)]:
+            autotune.activate(tuner_on if variant == "on" else None)
+            t0 = time_mod.perf_counter()
+            _settle(steppers[variant]())
+            times[variant].append(time_mod.perf_counter() - t0)
+    autotune.activate(None)
+
+    def median(v):
+        v = sorted(v)
+        return v[len(v) // 2] if v else 0.0
+
+    base_s = median(times["off"])
+    sample_extra_s = median(
+        [on - off for off, on in zip(times["off"], times["on"])]
+    )
+    control_extra_s = median(
+        [o2 - off for off, o2 in zip(times["off"], times["off2"])]
+    )
+    overhead_pct = (
+        100.0 * sample_extra_s / default_interval / base_s
+        if base_s > 0 else 0.0
+    )
+    control_pct = (
+        100.0 * control_extra_s / default_interval / base_s
+        if base_s > 0 else 0.0
+    )
+    print(json.dumps({
+        "metric": "autotune_overhead",
+        "n_workers": n,
+        "payload_mb": round(layers * dim * dim * 4 / 1e6, 2),
+        "interval": default_interval,
+        "ms_per_step_off": round(base_s * 1e3, 3),
+        "ms_sampled_step_extra": round(sample_extra_s * 1e3, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "control_aa_pct": round(control_pct, 3),
+        "unsampled_program_shared": unsampled_shared,
+        "bitwise_identical": bitwise,
+        "samples": samples,
+    }))
+    bf.shutdown()
+
+    bf_metrics.flush()
+    for k, v in old_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    if os.environ.get("BENCH_ASSERT", "1") != "0":
+        assert detected, (
+            f"doctor failed to name the injected edge "
+            f"({kill_src}, {kill_dst}): named {named}"
+        )
+        assert swap is not None and trigger_names_edge, (
+            f"no swap decision naming the injected edge: {chaos_line}"
+        )
+        assert migrated_excludes, (
+            "migrated topology kept the blamed edge at full weight"
+        )
+        assert wire_ratio is not None and wire_ratio >= 2.0, (
+            f"measured wire cost did not recover: {chaos_line}"
+        )
+        assert chaos_line["stale_dispatches"] == 0
+        assert finite, "training state went non-finite across the swap"
+        assert recovery_line["advisory_fired"] and \
+            recovery_line["advisory_names_edge"], recovery_line
+        assert recovery_line["migrated_excludes_edge"], recovery_line
+        assert eff_recovered is not None and eff_recovered >= 0.9, (
+            f"mixing efficiency did not recover: {recovery_line}"
+        )
+        assert recovery_line["recovered_step_ratio"] >= 2.0, (
+            recovery_line
+        )
+        assert dry_line["migrations_zero"] and \
+            dry_line["decisions"] >= 1, dry_line
+        assert dry_line["actions"] == ["dry_run_swap"], dry_line
+        assert audit_line["flight_side_table_has_swap"], audit_line
+        assert audit_line["jsonl_reconstruction_matches"], audit_line
+        assert audit_line["dump_reconstruction_matches"], audit_line
+        assert audit_line["report_joins_verification"], audit_line
+        assert unsampled_shared, (
+            "enabling the controller changed the compiled cache entries"
+        )
+        assert bitwise, (
+            "enabling the controller changed the training state bitwise"
+        )
+        assert overhead_pct <= 1.0, (
+            f"autotune overhead {overhead_pct:.3f}% exceeds the 1% "
+            f"acceptance bound at interval {default_interval}"
+        )
+    return 0
+
+
 def run_transformer() -> int:
     """TransformerLM train-step throughput: tokens/sec + MFU at long
     sequence over the Pallas flash kernels (fwd + custom-VJP bwd).
@@ -3510,7 +4049,8 @@ def run_all() -> int:
 
     for mode in ("scaling", "plan", "overlap", "metrics", "elastic",
                  "flight", "attribution", "health", "staleness",
-                 "quant", "gossip", "flash", "transformer"):
+                 "autotune", "quant", "gossip", "flash",
+                 "transformer"):
         env = dict(os.environ, BENCH_MODE=mode)
         try:
             proc = subprocess.run(
@@ -3554,6 +4094,7 @@ def main() -> int:
         "attribution": run_attribution,
         "health": run_health,
         "staleness": run_staleness,
+        "autotune": run_autotune,
         "quant": run_quant,
         "gossip": run_gossip_overhead,
         "transformer": run_transformer,

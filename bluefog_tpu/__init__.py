@@ -91,6 +91,7 @@ from bluefog_tpu import flight
 from bluefog_tpu.flight import dump as flight_dump
 from bluefog_tpu import attribution
 from bluefog_tpu import attribution as doctor  # bf.doctor facade
+from bluefog_tpu import autotune
 from bluefog_tpu import health
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
@@ -341,6 +342,7 @@ __all__ = [
     "flight_dump",
     "attribution",
     "doctor",
+    "autotune",
     "health",
     "staleness",
     "metrics",

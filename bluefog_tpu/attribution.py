@@ -406,10 +406,10 @@ class StepDoctor:
                 (s, d),
                 min(factors.get(s, 1.0), factors.get(d, 1.0)),
             )
-            if f < 1.0:
-                delay += (1.0 / f - 1.0) * compiler.round_cost_s(
-                    payload_bytes
-                )
+            # shared pricing with the autotune candidate scorer: the
+            # penalty a probe measures here is exactly what a candidate
+            # still carrying this edge is charged there
+            delay += compiler.degraded_round_penalty_s(payload_bytes, f)
         return delay
 
     def _readback_s(self, ctx, elems: int) -> float:

@@ -85,6 +85,7 @@ __all__ = [
     "min_rounds",
     "round_cost_s",
     "plan_cost_s",
+    "degraded_round_penalty_s",
     "pipelined_cost_s",
     "predicted_round_costs_s",
     "choose_chunks",
@@ -305,6 +306,22 @@ def round_cost_s(payload_bytes: float, congestion: float = 1.0) -> float:
 def plan_cost_s(n_rounds: int, payload_bytes: float) -> float:
     """Rounds are sequential: plan cost = rounds x per-round cost."""
     return n_rounds * round_cost_s(payload_bytes)
+
+
+def degraded_round_penalty_s(
+    payload_bytes: float, factor: float, congestion: float = 1.0
+) -> float:
+    """Extra seconds one ppermute round pays when a crossing link runs
+    at ``factor`` of its healthy bandwidth: the round's modeled cost
+    scaled by ``1/factor - 1``. The ONE pricing shared by the chaos
+    layer's deterministic wire simulation (the attribution doctor's
+    probe delays, :mod:`bluefog_tpu.attribution`) and the autotune
+    controller's candidate scorer (:mod:`bluefog_tpu.autotune`) — a
+    candidate that still carries a blamed edge must pay exactly the
+    slowdown the probes would measure on it."""
+    if not 0.0 < factor < 1.0:
+        return 0.0
+    return (1.0 / factor - 1.0) * round_cost_s(payload_bytes, congestion)
 
 
 def pipelined_cost_s(

@@ -393,6 +393,12 @@ def init(
     from bluefog_tpu import staleness as _staleness
 
     _staleness.on_init(_context)
+    # Autotune controller (BLUEFOG_AUTOTUNE=1): fresh session per mesh
+    # — stale hysteresis state or a rollback target captured against a
+    # torn-down mesh must never actuate on the new one.
+    from bluefog_tpu import autotune as _autotune
+
+    _autotune.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -414,9 +420,13 @@ def shutdown() -> None:
     from bluefog_tpu import metrics as _metrics
     from bluefog_tpu import timeline as _tl
 
+    from bluefog_tpu import autotune as _autotune
     from bluefog_tpu import staleness as _staleness
 
     _elastic.stop()
+    # the controller goes first: its session_end summary must flush
+    # while the surfaces it writes through are still up
+    _autotune.on_shutdown()
     _attribution.on_shutdown()
     _health.on_shutdown()
     _staleness.on_shutdown()

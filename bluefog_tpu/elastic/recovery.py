@@ -536,6 +536,36 @@ class ElasticSession:
             record.topo_version,
         )
 
+    # -- controller migration ------------------------------------------------
+
+    def adopt_topology(self, topo, optimizer=None) -> None:
+        """Adopt a new BASE topology mid-run — the ``bf.autotune``
+        migration path. The given graph becomes the base future
+        repairs (and rejoins) compute from, and what is INSTALLED now
+        is its repair to the *current* live set through the same
+        prune + renormalize + ``set_topology`` path a failure repair
+        takes — so a controller migration can never update the
+        topology but leave optimizer-side weights stale, and a later
+        rejoin restores the NEW base's edges, not the pre-migration
+        graph's."""
+        self._base_topo = topo
+        self._install_topology(
+            optimizer,
+            self.membership.live_ranks(),
+            self._policy_for(optimizer),
+            self.membership.degraded(),
+        )
+        metrics_mod.counter("bluefog.elastic.migrations").inc()
+        tl.timeline_record_instant(
+            f"elastic:migrate step={self.step} "
+            f"(topology v{self.ctx.topo_version})", "REPAIR",
+        )
+        flight.record(
+            "migrate", step=self.step,
+            live=list(self.membership.live_ranks()),
+            topo_version=self.ctx.topo_version,
+        )
+
     # -- rejoin --------------------------------------------------------------
 
     def rejoin(self, rank: int, params=None, optimizer=None):

@@ -90,6 +90,7 @@ __all__ = [
     "note_plan",
     "note_fault",
     "note_advisory",
+    "note_decision",
     "dump",
     "maybe_dump",
     "dump_dir",
@@ -160,6 +161,11 @@ _advisories: List[dict] = []  # bounded side table of doctor advisories
 # (bluefog_tpu.attribution): a postmortem that cannot see "degraded_link
 # fired 40 minutes ago" mis-tells the story, so advisory history gets
 # the same eviction-proof treatment as faults
+_decisions: List[dict] = []  # bounded side table of autotune decisions
+# (bluefog_tpu.autotune): a postmortem of a run whose topology the
+# controller changed mid-flight must carry WHY — the swap/rollback
+# history survives ring eviction exactly like the advisories that
+# triggered it
 _plans_lock = threading.Lock()
 _hooks_installed = False
 _prev_excepthook = None
@@ -212,6 +218,7 @@ def reconfigure() -> None:
         _plans.clear()
         _faults.clear()
         _advisories.clear()
+        _decisions.clear()
     del _dump_history[:]
 
 
@@ -300,6 +307,21 @@ def note_advisory(**data) -> None:
     })
 
 
+def note_decision(**data) -> None:
+    """Record an autotune controller decision
+    (:mod:`bluefog_tpu.autotune`) in BOTH the ring and a bounded side
+    table, mirroring :func:`note_advisory`: the postmortem of a run
+    whose topology was swapped mid-flight must name the decision that
+    swapped it — and that record must survive ring eviction on a long
+    run."""
+    if not enabled():
+        return
+    with _plans_lock:
+        _decisions.append(dict(data))
+        del _decisions[:-64]
+    record("autotune", **data)
+
+
 def _clock_triple() -> dict:
     """The cross-rank alignment anchor: the same instant on all three
     clocks this process emits timestamps in — wall (shared across
@@ -379,6 +401,7 @@ def _build_dump(reason: str) -> dict:
         out["comm_plans"] = list(_plans)
         out["fault_events"] = list(_faults)
         out["advisories"] = list(_advisories)
+        out["autotune_decisions"] = list(_decisions)
     try:
         out["metrics"] = metrics_mod.snapshot()
     except Exception:

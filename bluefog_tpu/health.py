@@ -1102,6 +1102,18 @@ class HealthPlane:
         budget)."""
         rep = self._build_report()
         rep["healthz"] = healthz_verdict(self)
+        # the autotune controller's decision summary rides the /fleet
+        # surface: an operator reading the fleet table must see that a
+        # rank's topology is being actively re-tuned (and how often it
+        # rolled back) next to the health numbers that drove it
+        try:
+            from bluefog_tpu import autotune as autotune_mod
+
+            tuner = autotune_mod.active()
+            if tuner is not None:
+                rep["autotune"] = tuner.summary()
+        except Exception:
+            pass
         return rep
 
     def dump(self, path: str) -> str:

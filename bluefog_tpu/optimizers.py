@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import optax
 
 from bluefog_tpu import attribution
+from bluefog_tpu import autotune as autotune_mod
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
 from bluefog_tpu import health as health_mod
@@ -1125,6 +1126,14 @@ class _GossipOptimizer:
                 ctx, step=self._step_count - 1, plan=self._last_plan,
                 payload_age=0, surface="sync",
             )
+            # autotune controller (BLUEFOG_AUTOTUNE): host-side
+            # decision logic only; a migration it makes lands as a
+            # topology-version bump this step path re-resolves next
+            # dispatch, exactly like an elastic repair
+            autotune_mod.observe_step(
+                ctx, step=self._step_count - 1, optimizer=self,
+                plan=self._last_plan,
+            )
         if ef:
             self._ef = ef_out
         if met:
@@ -1500,6 +1509,13 @@ class _GossipOptimizer:
                     ctx, step=self._step_count - 1,
                     plan=self._last_plan, payload_age=payload_age,
                     surface="delayed" if delay_now else "sync",
+                )
+                # autotune controller: host-side decision logic only —
+                # a migration lands as a topology-version bump the
+                # fused path re-resolves next dispatch
+                autotune_mod.observe_step(
+                    ctx, step=self._step_count - 1, optimizer=self,
+                    plan=self._last_plan,
                 )
                 if delay_now:
                     # the dispatch above refilled the double buffer

@@ -681,6 +681,112 @@ def test_bench_diff_wire_columns_are_tooling_gained(tmp_path):
     assert cell["verdict"].startswith("comparable"), cell
 
 
+def test_autotune_evidence_file_committed():
+    """AUTOTUNE_EVIDENCE.json (the committed BENCH_MODE=autotune
+    output) carries the acceptance facts: the injected degraded link
+    detected through the real doctor advisory stream with the decision
+    record naming it in its trigger set, the migrated topology
+    excluding the blamed edge with zero stale dispatches and the
+    measured wire cost recovering, mixing efficiency recovering past
+    the gate in the deterministic lossy-link replay, controller
+    overhead <=1% at the default interval with the A/A control and
+    structural + bitwise pins, the dry-run pass recording full history
+    with zero migrations, and the audit trail round-tripping through
+    every surface — plus provenance and the ambient anchor."""
+    path = os.path.join(REPO, "AUTOTUNE_EVIDENCE.json")
+    assert os.path.exists(path), "AUTOTUNE_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    chaos = [l for l in lines if l.get("metric") == "autotune_chaos"]
+    assert chaos, lines
+    assert chaos[0]["detected_by_doctor"] is True
+    assert chaos[0]["injected_edge"] in chaos[0]["edges_named"]
+    assert chaos[0]["decision_action"] == "swap"
+    assert chaos[0]["trigger_names_edge"] is True
+    assert chaos[0]["migrated_excludes_edge"] is True
+    assert chaos[0]["edge_weight_after"] < chaos[0]["edge_weight_before"]
+    assert chaos[0]["comm_wire_recovery_ratio"] >= 2.0
+    assert chaos[0]["stale_dispatches"] == 0
+    assert chaos[0]["training_state_finite"] is True
+    rec = [
+        l for l in lines
+        if l.get("metric") == "autotune_mixing_recovery"
+    ]
+    assert rec, lines
+    assert rec[0]["advisory_fired"] is True
+    assert rec[0]["advisory_names_edge"] is True
+    assert rec[0]["efficiency_recovered"] >= 0.9
+    assert rec[0]["efficiency_degraded"] < rec[0]["efficiency_recovered"]
+    assert rec[0]["recovered_step_ratio"] >= 2.0
+    assert rec[0]["migrated_excludes_edge"] is True
+    assert "calibration" in rec[0]  # the sim channel is disclosed
+    dry = [l for l in lines if l.get("metric") == "autotune_dry_run"]
+    assert dry, lines
+    assert dry[0]["migrations_zero"] is True
+    assert dry[0]["swaps"] == 0
+    assert dry[0]["decisions"] >= 1
+    assert dry[0]["actions"] == ["dry_run_swap"]
+    assert dry[0]["candidates_recorded"] is True
+    audit = [l for l in lines if l.get("metric") == "autotune_audit"]
+    assert audit, lines
+    assert audit[0]["flight_side_table_has_swap"] is True
+    assert audit[0]["jsonl_reconstruction_matches"] is True
+    assert audit[0]["dump_reconstruction_matches"] is True
+    assert audit[0]["report_joins_verification"] is True
+    assert audit[0]["fleet_block"].get("swaps", 0) >= 1
+    overhead = [
+        l for l in lines if l.get("metric") == "autotune_overhead"
+    ]
+    assert overhead, lines
+    assert overhead[0]["overhead_pct"] <= 1.0
+    assert "control_aa_pct" in overhead[0]
+    assert overhead[0]["unsampled_program_shared"] is True
+    assert overhead[0]["bitwise_identical"] is True
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_autotune_columns_are_tooling_gained(tmp_path):
+    """The autotune evidence adds controller-bookkeeping columns
+    (decision counts, predicted objectives, recovery ratios) to
+    cells; against a pre-autotune artifact their one-sided appearance
+    must read as tooling-gained-a-column, never a timing-harness
+    break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_autotune_cols):
+        row = {
+            "metric": "gossip_step", "n_workers": 8,
+            "ms_per_step": 10.0, "median": 10.1, "min": 9.9,
+        }
+        if with_autotune_cols:
+            row["decisions"] = 3
+            row["swaps"] = 1
+            row["rollbacks"] = 0
+            row["recovered_step_ratio"] = 17.7
+        path.write_text(
+            json.dumps(prov) + "\n" + json.dumps(row) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
 def test_staleness_evidence_file_committed():
     """STALENESS_EVIDENCE.json (the committed BENCH_MODE=staleness
     output) carries the acceptance facts: synchronous-path delivered

@@ -62,8 +62,9 @@ def test_tools_enumerated():
     drops a tool from the smoke test should be deliberate)."""
     names = {os.path.basename(t) for t in TOOLS}
     assert {
-        "bench_diff.py", "doctor.py", "fleet_report.py",
-        "metrics_report.py", "staleness_report.py", "trace_merge.py",
-        "hlo_overlap_scan.py", "hlo_dump.py", "perf_probe.py",
-        "resnet_layer_profile.py", "transformer_stage_profile.py",
+        "autotune_report.py", "bench_diff.py", "doctor.py",
+        "fleet_report.py", "metrics_report.py", "staleness_report.py",
+        "trace_merge.py", "hlo_overlap_scan.py", "hlo_dump.py",
+        "perf_probe.py", "resnet_layer_profile.py",
+        "transformer_stage_profile.py",
     } <= names

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Reconstruct the autotune controller's full decision history from
+committed artifacts alone.
+
+The closed-loop controller (:mod:`bluefog_tpu.autotune`, docs/autotune.md)
+leaves two kinds of evidence on disk: the session dump
+(``bf.autotune.dump(path)`` — ``kind: "autotune_dump"``) and the
+``BLUEFOG_AUTOTUNE_FILE`` JSONL stream (one line per decision /
+verification). This tool joins them into the audit an operator (or a
+postmortem) needs: *why* each migration happened (the trigger
+advisories and blamed edges), *what it predicted* (every candidate
+scored, the chosen objective and gain), and *what it delivered* (the
+post-swap verification verdict, including rollbacks). No jax import,
+no live mesh.
+
+Usage::
+
+    python tools/autotune_report.py autotune_dump.json
+    python tools/autotune_report.py decisions.jsonl [--json]
+"""
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_artifact(path: str) -> Tuple[List[dict], List[dict], dict]:
+    """(decisions, verifications, meta) from either artifact form. A
+    dump object carries them pre-split; a JSONL stream is classified
+    line by line on its ``kind`` field."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and obj.get("kind") == "autotune_dump":
+        return (
+            list(obj.get("decisions") or []),
+            list(obj.get("verifications") or []),
+            {k: obj.get(k) for k in (
+                "interval", "dry_run", "cooldown", "trigger_streak",
+                "min_gain_frac", "rollback_frac", "summary",
+            )},
+        )
+    decisions: List[dict] = []
+    verifications: List[dict] = []
+    meta: dict = {}
+    found = False
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        kind = row.get("kind")
+        if kind == "decision":
+            decisions.append(row)
+            found = True
+        elif kind == "verification":
+            verifications.append(row)
+            found = True
+        elif kind == "session_end":
+            meta["summary"] = row.get("summary")
+            found = True
+    if not found:
+        raise ValueError(
+            f"{path} is neither an autotune dump (kind="
+            "'autotune_dump') nor an autotune JSONL stream "
+            "(decision/verification lines)"
+        )
+    return decisions, verifications, meta
+
+
+def join_history(decisions: List[dict],
+                 verifications: List[dict]) -> List[dict]:
+    """One entry per decision, its verification (if any) attached by
+    ``decision_seq`` — the swap -> delivered linkage the audit is
+    about."""
+    by_seq: Dict[int, dict] = {}
+    for v in verifications:
+        seq = v.get("decision_seq")
+        if seq is not None:
+            by_seq[int(seq)] = v
+    out = []
+    seen = set()
+    for d in sorted(decisions, key=lambda d: d.get("seq", 0)):
+        # the documented usage passes the dump JSON and/or the JSONL of
+        # the same session: one decision present in both must not count
+        # twice (the JSONL copy differs only by its export timestamp)
+        key = (d.get("seq"), d.get("step"), d.get("comm_steps"),
+               d.get("action"))
+        if key in seen:
+            continue
+        seen.add(key)
+        entry = dict(d)
+        v = by_seq.get(int(d.get("seq", -1)))
+        if v is not None:
+            entry["verification"] = v
+        out.append(entry)
+    return out
+
+
+def _fmt_objective(v: Optional[float]) -> str:
+    return "∞ (no contraction)" if v is None else f"{v:.4g}s"
+
+
+def sentences(history: List[dict]) -> List[str]:
+    """The human audit, one sentence block per decision."""
+    out: List[str] = []
+    for d in history:
+        act = d.get("action", "?")
+        head = (
+            f"decision #{d.get('seq')} at step {d.get('step')}: "
+            f"{act.upper()}"
+        )
+        if d.get("chosen"):
+            head += f" -> {d['chosen']}"
+        trigger_bits = []
+        for t in d.get("triggers", [])[:4]:
+            bit = t.get("kind", "?")
+            if t.get("edge") is not None:
+                bit += f" edge {t['edge']}"
+            if t.get("rank") is not None:
+                bit += f" rank {t['rank']}"
+            if t.get("source"):
+                bit += f" ({t['source']})"
+            trigger_bits.append(bit)
+        if trigger_bits:
+            head += "; triggered by " + ", ".join(trigger_bits)
+        if d.get("blamed"):
+            head += f"; blamed edges {d['blamed']}"
+        out.append(head)
+        pred = d.get("predicted") or {}
+        if act in ("swap", "dry_run_swap"):
+            line = (
+                "  predicted: objective "
+                f"{_fmt_objective(pred.get('objective_before_s'))}"
+                f" -> {_fmt_objective(pred.get('objective_after_s'))}"
+            )
+            if pred.get("gain_frac") is not None:
+                line += f" (gain {pred['gain_frac']:.0%})"
+            out.append(line)
+        elif act == "hold":
+            out.append(
+                "  held: no candidate beat the incumbent "
+                f"({_fmt_objective(pred.get('objective_before_s'))}) "
+                "by the minimum-gain margin"
+            )
+        elif act == "rollback":
+            out.append(
+                "  rolled back: post-swap verification regressed "
+                "against the pre-swap baseline"
+            )
+        v = d.get("verification")
+        if v is not None:
+            dv = v.get("delivered") or {}
+            line = f"  delivered: verdict {v.get('verdict')}"
+            if dv.get("step_ms") is not None:
+                line += (
+                    f"; step {dv['step_ms']}ms vs baseline "
+                    f"{dv.get('step_ms_baseline')}ms"
+                )
+            if dv.get("mixing_efficiency") is not None:
+                line += (
+                    f"; mixing efficiency {dv['mixing_efficiency']} "
+                    f"vs baseline "
+                    f"{dv.get('mixing_efficiency_baseline')}"
+                )
+            if v.get("rolled_back"):
+                line += "; ROLLED BACK"
+            out.append(line)
+    if not out:
+        out.append("no decisions on record")
+    return out
+
+
+def build_report(paths: List[str]) -> dict:
+    decisions: List[dict] = []
+    verifications: List[dict] = []
+    meta: dict = {}
+    unreadable: List[dict] = []
+    for p in paths:
+        try:
+            d, v, m = load_artifact(p)
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+            unreadable.append({"path": p, "error": str(e)[:200]})
+            continue
+        decisions += d
+        verifications += v
+        for k, val in m.items():
+            if val is not None:
+                meta.setdefault(k, val)
+    history = join_history(decisions, verifications)
+    actions: Dict[str, int] = {}
+    for d in history:
+        a = d.get("action", "?")
+        actions[a] = actions.get(a, 0) + 1
+    return {
+        "kind": "autotune_report",
+        "meta": meta,
+        "decisions": len(history),
+        "actions": actions,
+        "rollbacks": sum(
+            1 for v in verifications if v.get("rolled_back")
+        ),
+        "history": history,
+        "summary": sentences(history),
+        "unreadable": unreadable,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="+",
+                    help="autotune dump JSON (bf.autotune.dump) and/or "
+                         "BLUEFOG_AUTOTUNE_FILE JSONL streams")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as one JSON object")
+    ap.add_argument("--out", help="also write the JSON report here")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.artifacts)
+    if not report["history"] and report["unreadable"]:
+        print("error: no readable input", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    acts = ", ".join(
+        f"{k}={v}" for k, v in sorted(report["actions"].items())
+    ) or "none"
+    print(
+        f"autotune audit: {report['decisions']} decision(s) ({acts}), "
+        f"{report['rollbacks']} rollback(s)"
+    )
+    for line in report["summary"]:
+        print(f"  {line}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -88,8 +88,23 @@ HEALTH_DERIVED = {
     "rate_ratio", "time_to_eps_steps", "fleet_residual",
 }
 
+# Autotune controller columns that arrived with the closed-loop
+# evidence family (BENCH_MODE=autotune): decision counts and predicted
+# objectives are controller bookkeeping derived from the telemetry, not
+# timed measurements, so their one-sided appearance against a
+# pre-autotune artifact is the tooling gaining a column — never a
+# timing-harness change.
+AUTOTUNE_DERIVED = {
+    "decisions", "swaps", "rollbacks", "holds",
+    "objective_before_s", "objective_after_s", "predicted_gain_frac",
+    "recovered_step_ratio", "recovered_efficiency",
+    "autotune_overhead_pct",
+}
+
 # Every one-sided-tolerated derived column set.
-TOOLING_DERIVED = ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED
+TOOLING_DERIVED = (
+    ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
+)
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
 

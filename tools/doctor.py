@@ -36,6 +36,12 @@ The report contains:
   rollup, docs/health.md): mixing efficiency vs the spectral
   prediction, and the worst rank in the in-band fleet aggregate with
   its dominant advisory, named in the human-sentence section;
+- ``autotune`` — the closed-loop controller's decision history
+  (``--autotune``: a ``bf.autotune.dump()`` artifact or a
+  ``BLUEFOG_AUTOTUNE_FILE`` JSONL, docs/autotune.md): what the
+  controller did about the advisories above — swaps, holds, rollbacks
+  — joined into the same triage so "the topology changed at step N"
+  is never a mystery next to the advisory that caused it;
 - ``summary`` — the human sentences, most damning first.
 """
 
@@ -47,8 +53,10 @@ import sys
 from typing import Dict, List, Optional
 
 try:  # package context (tests import tools.doctor)
+    from tools import autotune_report as autotune_report_mod
     from tools import fleet_report as fleet_report_mod
 except ImportError:  # script context: tools/ itself is sys.path[0]
+    import autotune_report as autotune_report_mod
     import fleet_report as fleet_report_mod
 
 
@@ -162,6 +170,25 @@ def health_section(health: Optional[dict]) -> Optional[dict]:
     }
 
 
+def autotune_section(paths: Optional[List[str]]) -> Optional[dict]:
+    """Fold autotune artifacts into the triage: the decision summary
+    (the same joined history ``tools/autotune_report.py`` builds, so
+    the two tools can never tell different stories from one artifact)
+    plus the latest decisions for the human sentences."""
+    if not paths:
+        return None
+    report = autotune_report_mod.build_report(paths)
+    if not report["history"] and report["unreadable"]:
+        return {"unreadable": report["unreadable"]}
+    return {
+        "decisions": report["decisions"],
+        "actions": report["actions"],
+        "rollbacks": report["rollbacks"],
+        "last": report["history"][-3:],
+        "sentences": report["summary"],
+    }
+
+
 def step_time_trend(samples: List[dict], window: int = 4) -> Optional[dict]:
     """Early-window vs late-window medians of the decomposed series:
     where did the step time go, in which component?"""
@@ -227,10 +254,12 @@ def suspect_rounds(samples: List[dict], ratio: float = 3.0) -> List[dict]:
 
 def triage(attribution: dict, metrics_rows: List[dict],
            flight_dumps: List[dict],
-           health: Optional[dict] = None) -> dict:
+           health: Optional[dict] = None,
+           autotune: Optional[List[str]] = None) -> dict:
     samples = attribution.get("samples", [])
     advisories = list(attribution.get("advisories", []))
     health_view = health_section(health)
+    autotune_view = autotune_section(autotune)
 
     flight_advisories = []
     dump_reasons = []
@@ -323,6 +352,26 @@ def triage(attribution: dict, metrics_rows: List[dict],
                 f"{health_view['predicted_rate']:.4g}, measured "
                 f"{health_view.get('measured_rate')})"
             )
+    if autotune_view and autotune_view.get("decisions"):
+        acts = autotune_view["actions"]
+        sentence = (
+            f"autotune made {autotune_view['decisions']} decision(s) ("
+            + ", ".join(f"{k}={v}" for k, v in sorted(acts.items()))
+            + ")"
+        )
+        if autotune_view.get("rollbacks"):
+            sentence += (
+                f"; {autotune_view['rollbacks']} migration(s) "
+                "regressed and rolled back"
+            )
+        last = autotune_view.get("last") or []
+        if last:
+            d = last[-1]
+            sentence += (
+                f"; last: {d.get('action')} at step {d.get('step')}"
+                + (f" -> {d['chosen']}" if d.get("chosen") else "")
+            )
+        summary.append(sentence)
     for a in advisories[-5:]:
         detail = {
             k: v for k, v in a.items() if k not in ("kind", "step")
@@ -354,6 +403,7 @@ def triage(attribution: dict, metrics_rows: List[dict],
         "doctor_metrics": doctor_series,
         "gossip_metrics": gossip_series,
         "health": health_view,
+        "autotune": autotune_view,
         "summary": summary,
     }
 
@@ -369,6 +419,11 @@ def main(argv=None) -> int:
     ap.add_argument("--health",
                     help="health artifact (bf.health.dump) or "
                          "tools/fleet_report.py --json rollup")
+    ap.add_argument("--autotune", nargs="*", default=[],
+                    help="autotune artifacts (bf.autotune.dump JSON "
+                         "and/or BLUEFOG_AUTOTUNE_FILE JSONL) — folds "
+                         "the controller's decision history into the "
+                         "triage")
     ap.add_argument("--json", action="store_true",
                     help="print the full JSON report")
     ap.add_argument("--out", help="also write the JSON report here")
@@ -380,7 +435,8 @@ def main(argv=None) -> int:
     )
     flight_dumps = load_flight_dumps(args.flight)
     health = load_health(args.health) if args.health else None
-    report = triage(attribution, metrics_rows, flight_dumps, health)
+    report = triage(attribution, metrics_rows, flight_dumps, health,
+                    autotune=args.autotune)
 
     if args.out:
         with open(args.out, "w") as f:

@@ -113,6 +113,11 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
             continue
         last = d.get("last_sample") or {}
         hz = d.get("healthz") or {}
+        # decision-history columns (bluefog_tpu.autotune): an artifact
+        # written before the controller existed — or from a run with
+        # the controller off — simply lacks the block, and the row
+        # degrades to autotune=absent rather than faking zeros
+        at = d.get("autotune")
         rows.append({
             "source": src,
             "status": hz.get("status", "?"),
@@ -131,6 +136,16 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
             "dominant_advisory": dominant_advisory(
                 d.get("advisories") or []
             ),
+            "autotune_last_action": (
+                at.get("last_action") if at else None
+            ),
+            "autotune_decisions": (
+                at.get("decisions") if at else None
+            ),
+            "autotune_rollbacks": (
+                at.get("rollbacks") if at else None
+            ),
+            "autotune": "active" if at else "absent",
         })
         # any rank's in-band view serves as the fleet block (they agree
         # to within the disclosed push-sum residual); keep the one with
@@ -216,7 +231,9 @@ def main(argv=None) -> int:
           + (f", {report['unreadable']} unreadable" if
              report["unreadable"] else "") + ")")
     cols = ("source", "status", "step_ms_ewma", "consensus",
-            "mixing_efficiency", "advisories", "dominant_advisory")
+            "mixing_efficiency", "advisories", "dominant_advisory",
+            "autotune_last_action", "autotune_decisions",
+            "autotune_rollbacks")
     for r in report["processes"]:
         if r.get("unreadable"):
             err = f" ({r['error']})" if r.get("error") else ""
