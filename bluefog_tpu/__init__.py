@@ -75,6 +75,8 @@ from bluefog_tpu.utility import (
     broadcast_optimizer_state,
     allreduce_parameters,
 )
+from bluefog_tpu import async_gossip
+from bluefog_tpu.async_gossip import make_async_train_step
 from bluefog_tpu import checkpoint
 from bluefog_tpu import elastic
 from bluefog_tpu import ops
@@ -316,6 +318,8 @@ __all__ = [
     "turn_off_win_ops_with_associated_p",
     "win_associated_p",
     "make_train_step",
+    "async_gossip",
+    "make_async_train_step",
     "CommunicationType",
     "DistributedGradientAllreduceOptimizer",
     "DistributedAllreduceOptimizer",

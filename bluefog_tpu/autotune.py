@@ -424,6 +424,11 @@ class DecisionRecord:
     topo_version_before: int
     topo_version_after: int
     dry_run: bool
+    # whether an asynchronous gossip engine (bf.make_async_train_step)
+    # was live when the decision was taken: the audit trail must
+    # distinguish choices scored for a synchronous combine from ones
+    # made while the async push-sum lane owned the wire
+    async_mode: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -441,7 +446,19 @@ class DecisionRecord:
             "topo_version_before": self.topo_version_before,
             "topo_version_after": self.topo_version_after,
             "dry_run": self.dry_run,
+            "async_mode": self.async_mode,
         }
+
+
+def _async_mode() -> bool:
+    """True when an asynchronous gossip engine is live in this process
+    (decision records carry it; import deferred to avoid a cycle)."""
+    try:
+        from bluefog_tpu import async_gossip
+
+        return async_gossip.active() is not None
+    except Exception:
+        return False
 
 
 # -- the controller ------------------------------------------------------------
@@ -1051,6 +1068,7 @@ class TopologyAutotuner:
             topo_version_before=v_before,
             topo_version_after=int(ctx.topo_version),
             dry_run=self.dry_run,
+            async_mode=_async_mode(),
         )
         self._emit(record)
         return record
@@ -1168,6 +1186,7 @@ class TopologyAutotuner:
                 topo_version_before=v_before,
                 topo_version_after=int(ctx.topo_version),
                 dry_run=self.dry_run,
+                async_mode=_async_mode(),
             )
             self._emit_verification(verdict)
             self._emit(record)

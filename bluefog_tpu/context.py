@@ -399,6 +399,11 @@ def init(
     from bluefog_tpu import autotune as _autotune
 
     _autotune.on_init(_context)
+    # Async gossip engine registry: an engine's window died with the
+    # old mesh — a new context must not report (or repair) it.
+    from bluefog_tpu import async_gossip as _async_gossip
+
+    _async_gossip.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -423,10 +428,13 @@ def shutdown() -> None:
     from bluefog_tpu import autotune as _autotune
     from bluefog_tpu import staleness as _staleness
 
+    from bluefog_tpu import async_gossip as _async_gossip
+
     _elastic.stop()
     # the controller goes first: its session_end summary must flush
     # while the surfaces it writes through are still up
     _autotune.on_shutdown()
+    _async_gossip.on_shutdown()
     _attribution.on_shutdown()
     _health.on_shutdown()
     _staleness.on_shutdown()

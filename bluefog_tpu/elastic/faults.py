@@ -15,6 +15,8 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
     stall:rank=2,step=10,seconds=120
     stall:rank=2,step=10,steps=6,peer=3
     degrade:rank=1,step=4,factor=0.25
+    slow:rank=5,step=0,factor=10
+    slow:rank=5,step=20,factor=4,steps=50
 
 - ``kill``     — the rank is dead from ``step`` on (process crash).
 - ``stall``    — the rank blocks for ``seconds`` at ``step``. A stall at
@@ -40,6 +42,17 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
   deterministically (:meth:`~bluefog_tpu.elastic.recovery.
   ElasticSession.simulated_wire_factors`) so degraded-link *detection*
   is testable on a mesh with no physically slow link.
+- ``slow``     — rank-scoped COMPUTE dilation: from ``step`` on the
+  rank's local steps take ``factor`` (≥ 1) times as long, so on the
+  asynchronous gossip engine's tick clock its cadence period
+  multiplies by ``ceil(factor)``
+  (:meth:`~bluefog_tpu.elastic.recovery.ElasticSession.
+  simulated_compute_dilation` — the compute analogue of the
+  link-scoped ``degrade``). An optional ``steps=S`` bounds the
+  dilation to ``S`` session steps; without it the fault is permanent.
+  This is the 10x-straggler chaos primitive the ``BENCH_MODE=async``
+  evidence drives: rank-scoped by definition (``peer=`` is rejected —
+  a slow *chip* has no single slow edge).
 
 Programmatic equivalent: :func:`bluefog_tpu.elastic.inject`.
 """
@@ -52,7 +65,7 @@ __all__ = ["Fault", "FaultPlan", "parse_fault_plan", "FAULT_PLAN_ENV"]
 
 FAULT_PLAN_ENV = "BLUEFOG_FAULT_PLAN"
 
-_KINDS = ("kill", "stall", "degrade")
+_KINDS = ("kill", "stall", "degrade", "slow")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,15 +105,27 @@ class Fault:
             raise ValueError(
                 f"degrade factor must be in (0, 1], got {self.factor}"
             )
+        if self.kind == "slow" and self.factor < 1.0:
+            raise ValueError(
+                f"slow factor is a compute dilation and must be >= 1, "
+                f"got {self.factor} (a value below 1 would mean a "
+                "SPEEDUP; for a slow link use degrade)"
+            )
+        if self.kind == "slow" and self.seconds:
+            raise ValueError(
+                "seconds= does not apply to slow faults (the dilation "
+                "is a per-step factor; bound it with steps=)"
+            )
         if self.peer >= 0 and self.kind not in ("degrade", "stall"):
             raise ValueError(
                 f"peer= only applies to degrade and stall faults, got "
-                f"kind {self.kind!r}"
+                f"kind {self.kind!r} (a slow fault dilates the RANK's "
+                "compute — there is no per-edge form)"
             )
-        if self.hold_steps and self.kind != "stall":
+        if self.hold_steps and self.kind not in ("stall", "slow"):
             raise ValueError(
-                f"steps= only applies to stall faults, got kind "
-                f"{self.kind!r}"
+                f"steps= only applies to stall and slow faults, got "
+                f"kind {self.kind!r}"
             )
         if self.hold_steps < 0:
             raise ValueError(

@@ -220,7 +220,10 @@ class ElasticSession:
         ``peer`` narrows a degrade (or stall) fault to the single
         directed edge ``(rank, peer)``; ``steps`` gives a stall its
         step-clock extent (the staleness observatory's deterministic
-        payload-hold simulation)."""
+        payload-hold simulation) or bounds a ``slow`` fault's
+        compute-dilation window; ``factor`` is the link scale for
+        ``degrade`` (in (0, 1]) and the compute dilation for ``slow``
+        (>= 1)."""
         fault = Fault(kind=kind, rank=int(rank), step=int(step),
                       seconds=float(seconds), factor=float(factor),
                       peer=int(peer), hold_steps=int(steps))
@@ -248,6 +251,25 @@ class ElasticSession:
             if f.kind == "degrade" and f.step <= self.step:
                 key = (f.rank, f.peer) if f.peer >= 0 else f.rank
                 out[key] = min(out.get(key, 1.0), f.factor)
+        return out
+
+    def simulated_compute_dilation(self) -> Dict[int, float]:
+        """``slow`` faults active at the current session step, as a
+        ``{rank: factor >= 1}`` compute-dilation map — the chaos
+        layer's deterministic stand-in for a physically slow chip
+        (the compute analogue of :meth:`simulated_wire_factors`). The
+        asynchronous gossip engine multiplies a dilated rank's cadence
+        period by ``ceil(factor)``; the ``BENCH_MODE=async`` straggler
+        scenario models synchronous step time as ``max_r(factor_r)``
+        per step. A fault with ``steps=S`` expires after ``S`` session
+        steps; without it the dilation is permanent."""
+        out: Dict[int, float] = {}
+        for f in self.plan.faults:
+            if f.kind != "slow" or self.step < f.step:
+                continue
+            if f.hold_steps > 0 and self.step >= f.step + f.hold_steps:
+                continue
+            out[f.rank] = max(out.get(f.rank, 1.0), f.factor)
         return out
 
     def simulated_stale_steps(self) -> Dict:
@@ -340,6 +362,16 @@ class ElasticSession:
                     f"elastic:degrade rank={fault.rank} "
                     f"factor={fault.factor:g}", "FAULT"
                 )
+        elif fault.kind == "slow":
+            # compute dilation: never a death verdict, never a repair
+            # trigger — a slow rank is exactly the rank the async
+            # engine must keep (its throughput cost stays its own);
+            # the dilation feeds simulated_compute_dilation
+            metrics_mod.counter("bluefog.elastic.slow_faults").inc()
+            tl.timeline_record_instant(
+                f"elastic:slow rank={fault.rank} "
+                f"factor={fault.factor:g}", "FAULT"
+            )
 
     # -- detection + repair --------------------------------------------------
 

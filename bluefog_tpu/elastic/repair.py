@@ -36,13 +36,20 @@ Policies
     the standard convention. Consensus is preserved but lands on the
     stationary-distribution-weighted average, not necessarily uniform.
 
-``push_sum`` (push-sum / window family)
+``push_sum`` (push-sum / window family, incl. the asynchronous gossip
+engine)
     Renormalize each live *sender's* outgoing mass split (self + live
     out-neighbors) to sum to 1 — column-stochastic in the standard
     convention, i.e. mass-conserving: ``sum(p)`` over survivors is
     invariant after repair, so the push-sum correction ``x / p``
     converges to ``sum(x_live) / sum(p_live)`` — the mass-corrected
     survivor consensus (dead mass is lost exactly once, at the kill).
+    The async engine (:mod:`bluefog_tpu.async_gossip`, ``mode =
+    'push_sum'``) receives exactly these renormalized weights from the
+    repair install, and additionally *re-windows* on a membership
+    change: the pre-repair estimate ``x / p`` seeds the new window's
+    mass with ``p`` reset to 1, so mass accounting restarts cleanly
+    over the live set (docs/async.md).
 
 Degraded (live but slow) ranks are handled by scaling their cross edges
 by the recorded link factor before normalization; the ``average`` policy

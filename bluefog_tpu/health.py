@@ -1114,6 +1114,18 @@ class HealthPlane:
                 rep["autotune"] = tuner.summary()
         except Exception:
             pass
+        # the asynchronous gossip engine's summary rides the same
+        # surface: ticks vs local steps, staleness-gate activity, and
+        # the cadence map an operator needs to read the age-adjusted
+        # mixing score next to it (docs/async.md)
+        try:
+            from bluefog_tpu import async_gossip as async_mod
+
+            engine = async_mod.active()
+            if engine is not None:
+                rep["async"] = engine.summary()
+        except Exception:
+            pass
         return rep
 
     def dump(self, path: str) -> str:

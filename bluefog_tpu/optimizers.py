@@ -1527,6 +1527,21 @@ class _GossipOptimizer:
 
         return train_step
 
+    def make_async_train_step(self, loss_fn, has_aux: bool = False,
+                              **kwargs):
+        """Build the fully *asynchronous* train step: per-rank-cadence
+        push-sum gossip where no rank ever waits on a peer
+        (:func:`bluefog_tpu.async_gossip.make_async_train_step` — this
+        optimizer contributes its inner optax transformation and its
+        ``compression`` knob as the default wire tier). With
+        ``BLUEFOG_ASYNC=0`` this IS :meth:`make_train_step` — the
+        synchronous path, bitwise identical. See docs/async.md."""
+        from bluefog_tpu import async_gossip
+
+        return async_gossip.make_async_train_step(
+            self, loss_fn, has_aux=has_aux, **kwargs
+        )
+
     def lower_last_fused_hlo(self, params, opt_state, *batch) -> str:
         """Optimized HLO text of the most recently dispatched fused train
         step, lowered against the given operands (only their avals

@@ -41,7 +41,11 @@ under the same cache key, re-proven by ``BENCH_MODE=staleness``.
   buffer slot (local steps since the slot was last written, plus the
   age of the oldest uncollected push-sum mass), surfaced through
   :func:`bluefog_tpu.windows.get_win_age` and folded here by
-  :func:`observe_window`.
+  :func:`observe_window`;
+- the asynchronous gossip engine (:mod:`bluefog_tpu.async_gossip`) —
+  the same window age lane folded under ``surface="async"``: the
+  bounded-staleness gate reads exactly the ages the observatory
+  reports.
 
 **Chaos parity.** An injected ``stall`` fault with ``steps=``/``peer=``
 (:mod:`bluefog_tpu.elastic.faults`) deterministically holds the
@@ -518,15 +522,18 @@ class StalenessObservatory:
         self._export_line(sample)
         return sample
 
-    def observe_window(self, ctx, win, step: Optional[int] = None
-                       ) -> Optional[dict]:
+    def observe_window(self, ctx, win, step: Optional[int] = None,
+                       surface: str = "window") -> Optional[dict]:
         """Fold one window's host-tracked buffer/mass ages (the
         :mod:`bluefog_tpu.windows` age lane) on the window's own
         sampling clock (per-window — a shared counter would alias the
         modulo across windows and starve some of them forever). Called
-        by ``win_update`` and the fused window-optimizer step; a
-        breach here names the stale *source* edge exactly like the
-        gossip surface."""
+        by ``win_update``, the fused window-optimizer step, and the
+        asynchronous gossip engine (``surface="async"``,
+        :mod:`bluefog_tpu.async_gossip`) — the async lane's delivered
+        ages land in the same registry/fleet plumbing; a breach here
+        names the stale *source* edge exactly like the gossip
+        surface."""
         wname = getattr(win, "name", "?")
         count = self._wcounts.get(wname, 0)
         self._wcounts[wname] = count + 1
@@ -567,7 +574,7 @@ class StalenessObservatory:
             ).set(float(max(mass_ages.values())))
         sample: Dict[str, Any] = {
             "kind": "sample",
-            "surface": "window",
+            "surface": surface,
             "window": win.name,
             "step": int(step) if step is not None else clock,
             "window_clock": clock,
@@ -577,7 +584,7 @@ class StalenessObservatory:
         }
         if mass_ages:
             sample["mass_age_max"] = float(max(mass_ages.values()))
-        breached = self._unmuted_breaches("window", ages)
+        breached = self._unmuted_breaches(surface, ages)
         if breached:
             from bluefog_tpu.attribution import Advisory
 
@@ -594,7 +601,7 @@ class StalenessObservatory:
                     },
                     "age_max": age_max,
                     "bound": self.bound,
-                    "surface": "window",
+                    "surface": surface,
                     "window": win.name,
                     "suspect_faults": _suspect_faults(),
                 },
@@ -709,13 +716,15 @@ def observe_step(ctx, *, step: int, plan=None, payload_age: int = 0,
                 surface=surface)
 
 
-def observe_window(ctx, win, step: Optional[int] = None) -> None:
+def observe_window(ctx, win, step: Optional[int] = None,
+                   surface: str = "window") -> None:
     """Window-layer hook (``win_update`` / the fused window-optimizer
-    step). No-op when no session is active."""
+    step / the async gossip engine with ``surface="async"``). No-op
+    when no session is active."""
     obs = _observatory
     if obs is None:
         return
-    obs.observe_window(ctx, win, step=step)
+    obs.observe_window(ctx, win, step=step, surface=surface)
 
 
 def dump(path: str) -> Optional[str]:

@@ -374,6 +374,25 @@ def _note_local_step(win: _Window) -> None:
     win.clock += 1
 
 
+def _note_async_tick(win: _Window, written, folded) -> None:
+    """Host age-lane update for one asynchronous gossip tick
+    (:mod:`bluefog_tpu.async_gossip`): advance the clock, stamp exactly
+    the slots a *participating* sender wrote this tick (the async
+    exchange ships every structural round, but masked senders carry
+    zero mass — their slots must not read as fresh), record pending
+    accumulate-mass births, then clear the births of exactly the
+    folded slots. ``written``/``folded`` are [size, max_deg] bool."""
+    win.clock += 1
+    w = np.asarray(written, bool)
+    if w.any():
+        win.slot_written[w] = win.clock
+        fresh = w & (win.mass_birth < 0)
+        win.mass_birth[fresh] = win.clock
+    f = np.asarray(folded, bool)
+    if f.any():
+        win.mass_birth[f] = -1
+
+
 # -- the quantized window wire ------------------------------------------------
 
 

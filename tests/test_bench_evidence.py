@@ -787,6 +787,109 @@ def test_bench_diff_autotune_columns_are_tooling_gained(tmp_path):
     assert cell["verdict"].startswith("comparable"), cell
 
 
+def test_async_evidence_file_committed():
+    """ASYNC_EVIDENCE.json (the committed BENCH_MODE=async output)
+    carries the acceptance facts: one rank compute-dilated 10x
+    collapses synchronous fleet throughput to ~1/dilation while the
+    async lane's measured participation stays within ~1/N of nominal
+    (same artifact, same problem); convergence within tolerance of the
+    synchronous baseline; exact push-sum mass conservation per wire
+    tier (fp32/int8_ef/int4_ef) under random cadences; the
+    bounded-staleness gate engaging with an age histogram and the
+    ``async_staleness`` advisory naming the slow rank; and the
+    async-off dispatch pinned bitwise to the current optimizer path —
+    plus provenance and the ambient anchor."""
+    path = os.path.join(REPO, "ASYNC_EVIDENCE.json")
+    assert os.path.exists(path), "ASYNC_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    strag = [l for l in lines if l.get("metric") == "async_straggler"]
+    assert strag, lines
+    s = strag[0]
+    assert s["within_1_over_n"] is True
+    assert s["sync_collapse"] is True
+    assert s["fleet_ratio_async"] >= 1.0 - 1.5 / s["workers"]
+    assert s["fleet_ratio_sync"] <= 1.5 / s["dilation"]
+    assert s["dilation"] >= 10
+    assert 0 <= s["slow_rank"] < s["workers"]
+    assert "simulated" in s["dilation_model"]
+    assert s["measured_async_tick_ms"] > 0
+    assert s["measured_sync_step_ms"] > 0
+    conv = [l for l in lines if l.get("metric") == "async_convergence"]
+    assert conv, lines
+    assert conv[0]["within_tolerance"] is True
+    assert conv[0]["dist_to_opt_async"] <= (
+        conv[0]["tolerance_factor"] * conv[0]["dist_to_opt_sync"] + 1e-3
+    )
+    mass = [l for l in lines if l.get("metric") == "async_mass"]
+    assert mass, lines
+    assert mass[0]["conserved_all_tiers"] is True
+    assert set(mass[0]["tiers"]) == {"fp32", "int8_ef", "int4_ef"}
+    for tier, rec in mass[0]["tiers"].items():
+        assert rec["conserved"] is True, (tier, rec)
+        assert rec["mass_drift"] < rec["bound"], (tier, rec)
+    gate = [
+        l for l in lines if l.get("metric") == "async_staleness_gate"
+    ]
+    assert gate, lines
+    g = gate[0]
+    assert g["gate_engaged"] is True
+    assert g["advisory_names_slow_rank"] is True
+    assert g["age_max"] > g["max_age"]
+    assert g["age_hist"], g
+    assert any(int(a) > g["max_age"] for a in g["age_hist"])
+    assert g["fresh_edges_within_bound"] <= g["max_age"]
+    assert all(
+        int(s0) == strag[0]["slow_rank"] for s0, _d in g["advisory_edges"]
+    )
+    off = [l for l in lines if l.get("metric") == "async_off_bitwise"]
+    assert off, lines
+    assert off[0]["bitwise_identical"] is True
+    assert off[0]["dispatch_path_shared"] is True
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_async_columns_are_tooling_gained(tmp_path):
+    """The async evidence adds cadence-replay bookkeeping columns
+    (participation ratios, mass-drift pins, gate statistics); against
+    a pre-async artifact their one-sided appearance must read as
+    tooling-gained-a-column, never a timing-harness break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_async_cols):
+        row = {
+            "metric": "gossip_step", "n_workers": 8,
+            "ms_per_step": 10.0, "median": 10.1, "min": 9.9,
+        }
+        if with_async_cols:
+            row["fleet_ratio_async"] = 0.8875
+            row["fleet_ratio_sync"] = 0.1
+            row["mass_drift_max"] = 1.4e-5
+            row["age_max"] = 9
+        path.write_text(
+            json.dumps(prov) + "\n" + json.dumps(row) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
 def test_staleness_evidence_file_committed():
     """STALENESS_EVIDENCE.json (the committed BENCH_MODE=staleness
     output) carries the acceptance facts: synchronous-path delivered

@@ -98,6 +98,58 @@ def test_fault_plan_grammar_rejects(bad):
         parse_fault_plan(bad)
 
 
+def test_slow_fault_grammar_roundtrip():
+    """The rank-scoped compute-dilation kind: factor >= 1, optional
+    steps= duration, no peer/seconds form."""
+    plan = parse_fault_plan(
+        "slow:rank=5,step=0,factor=10; slow:rank=2,step=4,factor=3,steps=6"
+    )
+    assert [f.kind for f in plan.faults] == ["slow", "slow"]
+    f = plan.due(0)[0]
+    assert (f.rank, f.factor, f.hold_steps) == (5, 10.0, 0)
+    bounded = plan.due(4)[0]
+    assert (bounded.rank, bounded.factor, bounded.hold_steps) == (2, 3.0, 6)
+    plan.validate(SIZE)
+    # factor defaults to 1.0 — a no-op dilation is legal
+    parse_fault_plan("slow:rank=1,step=0")
+    with pytest.raises(ValueError, match="9"):
+        plan2 = parse_fault_plan("slow:rank=9,step=0,factor=2")
+        plan2.validate(SIZE)
+
+
+@pytest.mark.parametrize("bad", [
+    "slow:rank=1,step=0,factor=0.5",       # a slowdown must dilate
+    "slow:rank=1,step=0,factor=2,peer=3",  # rank-scoped by definition
+    "slow:rank=1,step=0,factor=2,seconds=5",
+])
+def test_slow_fault_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_plan(bad)
+
+
+def test_simulated_compute_dilation_window():
+    """inject() parity + the step-clock activation window: a slow
+    fault dilates from its step, expires after steps=, and never
+    triggers repair or a death verdict."""
+    _init()
+    session = bf.elastic.start()
+    session.inject("slow", rank=3, step=2, factor=10)
+    session.inject("slow", rank=1, step=4, factor=4, steps=3)
+    dilations = []
+    for step in range(10):
+        # the dilation map a dispatch at `step` would see
+        dilations.append(dict(session.simulated_compute_dilation()))
+        session.before_dispatch(None)  # replay faults, advance clock
+    assert dilations[0] == {} and dilations[1] == {}
+    assert dilations[2] == {3: 10.0}
+    assert dilations[4] == {3: 10.0, 1: 4.0}
+    assert dilations[6] == {3: 10.0, 1: 4.0}  # last active step for 1
+    assert dilations[7] == {3: 10.0}          # steps=3 expired
+    assert session.repairs == []              # never a repair trigger
+    assert session.membership.live_ranks() == tuple(range(SIZE))
+    assert metrics.snapshot()["bluefog.elastic.slow_faults"]["value"] == 2
+
+
 def test_fault_plan_env_and_validate(monkeypatch):
     monkeypatch.setenv("BLUEFOG_FAULT_PLAN", "kill:rank=9,step=0")
     plan = FaultPlan.from_env()

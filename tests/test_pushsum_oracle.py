@@ -156,6 +156,148 @@ def test_star_divergence_point_is_step_two():
     assert np.abs(got[1] - ref2).max() > 1e-3
 
 
+def window_mass(win):
+    """Total x mass in flight: window values + pending buffers."""
+    return float(np.sum(np.asarray(win.value), dtype=np.float64)) + \
+        float(np.sum(np.asarray(win.buffers), dtype=np.float64))
+
+
+def window_p_mass(win):
+    return float(np.sum(np.asarray(win.p), dtype=np.float64)) + \
+        float(np.sum(np.asarray(win.p_buffers), dtype=np.float64))
+
+
+@pytest.mark.parametrize("wire", [None, "int8_ef", "int4_ef"])
+def test_async_mass_conservation_random_cadences(wire):
+    """The asynchronous engine's push-sum mass-conservation property:
+    random per-rank cadences x wire tier, lr = 0 — total x mass
+    (window values + pending buffers) and total p mass are invariant
+    per tick to f32 rounding, NOT quantization precision (the sender
+    absorbs its shipped quantization residual; the _ef spellings ride
+    that exact absorption as their error feedback)."""
+    from bluefog_tpu import windows as win_mod
+
+    rng = np.random.RandomState(7)
+    graph = tu.RingGraph(SIZE, connect_style=1)
+    bf.set_topology(graph)
+    z0 = rng.randn(SIZE, 1024).astype(np.float32) * 2
+    periods = {r: int(p) for r, p in enumerate(rng.randint(1, 5, SIZE))}
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    params = {"w": jnp.asarray(z0)}
+    state = opt.init(params)
+
+    def loss_fn(p, target):
+        return 0.5 * jnp.sum((p["w"] - target) ** 2)
+
+    step = bf.make_async_train_step(
+        opt, loss_fn, cadence=periods, wire=wire, max_age=10 ** 6
+    )
+    batch = jnp.asarray(z0)
+    mass0 = float(np.sum(z0, dtype=np.float64))
+    scale = max(abs(mass0), float(np.abs(z0).sum()))
+    for t in range(20):
+        params, state, _ = step(params, state, batch)
+        win = win_mod._get_win(bf.get_context(), step.engine._name)
+        drift = abs(window_mass(win) - mass0)
+        assert drift < 1e-5 * scale, (
+            f"tick {t}: wire={wire} mass drift {drift} (scale {scale})"
+        )
+        assert abs(window_p_mass(win) - SIZE) < 1e-5
+
+
+@pytest.mark.parametrize("window_wire", [None, "int8", "int4"])
+def test_interleaved_accumulate_update_conserves_mass(
+    window_wire, monkeypatch,
+):
+    """Raw window-op form of the async property: a random interleave
+    of per-rank-participation ``win_accumulate`` (column-stochastic
+    shares, sitting-out ranks as ``None`` spec entries) and
+    per-rank-participation collecting ``win_update`` conserves total
+    mass under every window wire tier."""
+    if window_wire is not None:
+        monkeypatch.setenv("BLUEFOG_WINDOW_WIRE", window_wire)
+    rng = np.random.RandomState(11)
+    graph = tu.RingGraph(SIZE, connect_style=1)
+    bf.set_topology(graph)
+    z0 = rng.randn(SIZE, 1024).astype(np.float32)
+    x = bf.worker_values(lambda r: z0[r])
+    bf.win_create(x, "async_prop", zero_init=True)
+    bf.turn_on_win_ops_with_associated_p()
+    ctx = bf.get_context()
+    win = ctx.windows["async_prop"]
+    outs = ctx.out_neighbor_ranks()
+    mass0 = float(np.sum(z0, dtype=np.float64))
+    scale = float(np.abs(z0).sum())
+    for t in range(12):
+        if rng.rand() < 0.6:  # a partial-participation accumulate
+            part = rng.rand(SIZE) < 0.7
+            dst = [
+                {d: 1.0 / (len(outs[r]) + 1) for d in outs[r]}
+                if part[r] else None
+                for r in range(SIZE)
+            ]
+            sw = {
+                r: 1.0 / (len(outs[r]) + 1)
+                for r in range(SIZE) if part[r]
+            }
+            bf.win_accumulate(
+                name="async_prop", self_weight=sw, dst_weights=dst
+            )
+        else:  # a partial-participation collect
+            part = rng.rand(SIZE) < 0.7
+            nw = [
+                {s: 1.0 for s in win.in_neighbors[r]}
+                if part[r] else None
+                for r in range(SIZE)
+            ]
+            bf.win_update(
+                name="async_prop", self_weight=1.0,
+                neighbor_weights=nw, reset=True,
+            )
+        total = float(
+            np.sum(np.asarray(win.value), dtype=np.float64)
+        ) + float(np.sum(np.asarray(win.buffers), dtype=np.float64))
+        assert abs(total - mass0) < 1e-5 * max(scale, 1.0), (
+            f"op {t}: wire={window_wire} drift {abs(total - mass0)}"
+        )
+    bf.turn_off_win_ops_with_associated_p()
+
+
+def test_get_win_age_oracle_decoupled_cadences():
+    """Host-oracle pin of the window age lane under the async engine's
+    decoupled cadences: after T ticks, the slot fed by sender s (period
+    P_s) must report age T - last_write_clock, where sender s last
+    wrote at tick floor((T-1)/P_s)*P_s (stamped at clock tick+1)."""
+    rng = np.random.RandomState(13)
+    graph = tu.RingGraph(SIZE, connect_style=1)
+    bf.set_topology(graph)
+    z0 = rng.randn(SIZE, DIM).astype(np.float32)
+    periods = {r: int(p) for r, p in enumerate(rng.randint(1, 6, SIZE))}
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.0))
+    params = {"w": jnp.asarray(z0)}
+    state = opt.init(params)
+
+    def loss_fn(p, target):
+        return 0.5 * jnp.sum((p["w"] - target) ** 2)
+
+    step = bf.make_async_train_step(
+        opt, loss_fn, cadence=periods, max_age=10 ** 6
+    )
+    batch = jnp.asarray(z0)
+    for ticks in (1, 3, 7, 12):
+        while step.engine._tick < ticks:
+            params, state, _ = step(params, state, batch)
+        ages = bf.get_win_age(step.engine._name)
+        for r in range(SIZE):
+            for s, age in ages[r].items():
+                last_tick = ((ticks - 1) // periods[s]) * periods[s]
+                expected = ticks - (last_tick + 1)
+                assert age == expected, (
+                    f"T={ticks} edge {s}->{r}: age {age} != {expected} "
+                    f"(period {periods[s]})"
+                )
+
+
 def test_star_accumulated_p_preserves_exact_mean():
     """What the departure buys: on the star the accumulated-p recursion
     still converges to the exact average; the reference's reset recursion

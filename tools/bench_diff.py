@@ -101,9 +101,23 @@ AUTOTUNE_DERIVED = {
     "autotune_overhead_pct",
 }
 
+# Asynchronous-gossip columns that arrived with the async evidence
+# family (BENCH_MODE=async): participation ratios, mass-drift pins and
+# gate statistics are cadence-replay bookkeeping derived from engine
+# counters, not timed measurements, so their one-sided appearance
+# against a pre-async artifact is the tooling gaining a column — never
+# a timing-harness change.
+ASYNC_DERIVED = {
+    "fleet_ratio_async", "fleet_ratio_sync", "local_steps",
+    "mass_drift_max", "stale_drops", "age_max",
+    "dist_to_opt_sync", "dist_to_opt_async",
+    "fresh_edges_within_bound",
+}
+
 # Every one-sided-tolerated derived column set.
 TOOLING_DERIVED = (
     ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
+    | ASYNC_DERIVED
 )
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")
