@@ -95,6 +95,7 @@ from bluefog_tpu import attribution
 from bluefog_tpu import attribution as doctor  # bf.doctor facade
 from bluefog_tpu import autotune
 from bluefog_tpu import health
+from bluefog_tpu import sharding
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
@@ -348,6 +349,7 @@ __all__ = [
     "doctor",
     "autotune",
     "health",
+    "sharding",
     "staleness",
     "metrics",
     "metrics_snapshot",

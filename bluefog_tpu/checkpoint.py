@@ -25,6 +25,7 @@ onto any mesh of the same worker count); orbax handles atomicity
 """
 
 import hashlib
+import json
 import os
 from typing import Optional, Tuple
 
@@ -33,6 +34,7 @@ import numpy as np
 import jax
 
 from bluefog_tpu import context as ctx_mod
+from bluefog_tpu import sharding
 from bluefog_tpu import windows as win_mod
 from bluefog_tpu.logging_util import logger
 
@@ -52,21 +54,31 @@ def topology_digest(topo) -> Optional[str]:
     ).hexdigest()
 
 
-def _graph_info() -> Optional[dict]:
+def _graph_info(optimizer=None) -> Optional[dict]:
     """The graph-shape block ``save`` records: world size, topology
-    version + digest, and the elastic live set (everyone, without an
-    elastic session). None when bluefog is not initialized."""
+    version + digest, the elastic live set (everyone, without an
+    elastic session), and — when the optimizer runs weight-update
+    sharding — the shard-layout descriptor. None when bluefog is not
+    initialized."""
     if not ctx_mod.is_initialized():
         return None
     ctx = ctx_mod.get_context()
     m = ctx.elastic_membership
     live = list(m.live_ranks()) if m is not None else list(range(ctx.size))
-    return {
+    info = {
         "world_size": int(ctx.size),
         "topo_version": int(ctx.topo_version),
         "topo_digest": topology_digest(ctx.load_topology()),
         "live_ranks": live,
     }
+    layout = getattr(optimizer, "_shard_layout", None)
+    if layout is not None:
+        info["shard"] = {
+            "n_live": len(layout.live),
+            "master": bool(layout.master),
+            "groups": [[g.dtype, g.elems, g.slot] for g in layout.groups],
+        }
+    return info
 
 
 def _check_graph_info(info: dict, optimizer) -> None:
@@ -151,15 +163,68 @@ def _window_state(opt) -> Optional[dict]:
     }
 
 
+def _shard_layout_of(optimizer, opt_state):
+    """The active shard layout iff ``opt_state`` really is the sharded
+    form (a user may pass a replicated tree alongside a sharded
+    optimizer; trust the state, not the flag)."""
+    layout = getattr(optimizer, "_shard_layout", None)
+    if layout is None or not isinstance(opt_state, sharding.ShardedOptState):
+        return None
+    return layout
+
+
+def _gather_sharded_state(opt_state, layout) -> Tuple[dict, dict]:
+    """Gather-on-save: reconstruct every per-coordinate state group to
+    its full (shard-layout-independent) flat vector, so the checkpoint
+    restores onto ANY later live set — including one that no longer
+    contains the rank whose shard this was. Returns ``(leaves_by_key,
+    shard_info)`` where ``shard_info["slot_leaves"]`` records which
+    flatten-order leaves are slot leaves (and their group), the
+    structural map restore re-slices by."""
+    from bluefog_tpu.optimizers import _GossipOptimizer
+
+    leaves = jax.tree_util.tree_leaves(_to_host(opt_state))
+    out = {}
+    slot_leaves = []
+    for i, leaf in enumerate(leaves):
+        gi = _GossipOptimizer._shard_slot_group(tuple(leaf.shape), layout)
+        if gi is None:
+            out[f"leaf_{i:03d}"] = leaf
+        else:
+            out[f"leaf_{i:03d}"] = sharding.gather_rows(leaf, layout, gi)
+            slot_leaves.append([i, gi])
+    info = {
+        "version": 1,
+        "n_leaves": len(leaves),
+        "slot_leaves": slot_leaves,
+        "groups": [[g.dtype, g.elems] for g in layout.groups],
+        "master": bool(layout.master),
+    }
+    return out, info
+
+
 def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
-    """Write a checkpoint directory at ``path``/``step``; returns it."""
+    """Write a checkpoint directory at ``path``/``step``; returns it.
+
+    Under weight-update sharding (``BLUEFOG_SHARD=1``) the optimizer
+    state is saved GATHERED: full per-coordinate vectors, no shard
+    layout baked in — a restore re-slices under whatever live set is
+    then current, which is also how a real fleet recovers a shard whose
+    owner died (docs/sharding.md). A small ``<step>.graph.json`` sidecar
+    carries the graph-info block so restore can refuse a mismatched
+    world/live set BEFORE allocating any state buffers."""
     target = os.path.join(os.path.abspath(path), str(int(step)))
     payload = {
         "step": int(step),
         "params": _to_host(params),
         "opt_state": _to_host(opt_state),
     }
-    graph_info = _graph_info()
+    shard_layout = _shard_layout_of(optimizer, opt_state)
+    if shard_layout is not None:
+        gathered, shard_info = _gather_sharded_state(opt_state, shard_layout)
+        payload["opt_state"] = gathered
+        payload["shard_info"] = repr(shard_info)
+    graph_info = _graph_info(optimizer)
     if graph_info is not None:
         # recorded as a repr'd literal: orbax round-trips nested dicts of
         # mixed scalars/lists as arrays; a string survives exactly
@@ -192,7 +257,20 @@ def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
             ]
             payload["ef_sig"] = repr(optimizer._ef_sig)
     _checkpointer().save(target, payload, force=True)
+    if graph_info is not None:
+        # the pre-validation sidecar: restore reads THIS (a few hundred
+        # bytes) before asking orbax to materialize anything, so a
+        # live-set/world mismatch fails with the clear message instead
+        # of a shape error mid-restore with the buffers already
+        # allocated. Written as a sibling of the step directory —
+        # orbax owns the directory's contents.
+        with open(_sidecar_path(path, step), "w") as f:
+            json.dump({"graph_info": graph_info}, f)
     return target
+
+
+def _sidecar_path(path: str, step: int) -> str:
+    return os.path.join(os.path.abspath(path), f"{int(step)}.graph.json")
 
 
 def latest_step(path: str) -> Optional[int]:
@@ -215,12 +293,49 @@ def restore(path: str, step: Optional[int] = None,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {path}")
     target = os.path.join(os.path.abspath(path), str(int(step)))
+    # Pre-validate against the graph-info SIDECAR before orbax
+    # materializes anything: a restore of an elastic-repaired session
+    # whose live set no longer matches must fail with the clear
+    # message, not a shape error mid-restore with model-sized buffers
+    # already allocated. Checkpoints predating the sidecar fall through
+    # to the post-load check below.
+    pre_validated = False
+    side = _sidecar_path(path, step)
+    if ctx_mod.is_initialized() and os.path.exists(side):
+        try:
+            with open(side) as f:
+                side_info = json.load(f).get("graph_info")
+        except (OSError, ValueError):
+            side_info = None  # unreadable sidecar: post-load check runs
+        if side_info is not None:
+            _check_graph_info(side_info, optimizer)
+            pre_validated = True
     payload = _checkpointer().restore(target)
     graph_info = payload.get("graph_info")
-    if graph_info is not None and ctx_mod.is_initialized():
+    if (graph_info is not None and not pre_validated
+            and ctx_mod.is_initialized()):
         import ast
 
         _check_graph_info(ast.literal_eval(str(graph_info)), optimizer)
+    opt_state_out = payload["opt_state"]
+    shard_info = payload.get("shard_info")
+    if shard_info is not None:
+        import ast
+
+        opt_state_out = _reslice_sharded_state(
+            ast.literal_eval(str(shard_info)), payload, optimizer
+        )
+    elif (
+        optimizer is not None
+        and callable(getattr(optimizer, "_shard_active", None))
+        and optimizer._shard_active()
+    ):
+        raise ValueError(
+            "BLUEFOG_SHARD=1 but this checkpoint holds REPLICATED "
+            "optimizer state (saved with sharding off); restore with "
+            "BLUEFOG_SHARD=0, or re-save from a sharded run (sharded "
+            "saves are gathered and restore onto any live set)"
+        )
     if optimizer is not None:
         wstate = payload.get("window")
         from bluefog_tpu.optimizers import _WindowOptimizer
@@ -304,4 +419,73 @@ def restore(path: str, step: Optional[int] = None,
                 for pair in ef_saved
             )
             optimizer._ef_sig = ast.literal_eval(payload["ef_sig"])
-    return int(payload["step"]), payload["params"], payload["opt_state"]
+    return int(payload["step"]), payload["params"], opt_state_out
+
+
+def _reslice_sharded_state(info: dict, payload: dict, optimizer):
+    """Re-slice a gather-on-save sharded checkpoint under the CURRENT
+    live set: a fresh ``optimizer.init(params)`` provides the exact
+    state structure/avals for today's layout, then every gathered
+    per-coordinate vector is re-distributed into it and every
+    replicated leaf is installed verbatim. Refuses (with the reason)
+    when sharding is off, the dtype groups moved, or the master knob
+    flipped — silently loading would train a different model."""
+    if optimizer is None:
+        raise ValueError(
+            "checkpoint holds sharded optimizer state; pass "
+            "optimizer= so restore can re-slice it under the current "
+            "shard layout"
+        )
+    shard_ok = (
+        callable(getattr(optimizer, "_shard_active", None))
+        and optimizer._shard_active()
+    )
+    if not shard_ok:
+        raise ValueError(
+            "this checkpoint's optimizer state was saved under "
+            "BLUEFOG_SHARD=1 (gathered, shard-portable) but the given "
+            "optimizer is not sharding; set BLUEFOG_SHARD=1 on a "
+            "gradient-allreduce optimizer to restore it"
+        )
+    ref_state = optimizer.init(payload["params"])
+    layout = optimizer._shard_layout
+    saved_groups = [(str(g[0]), int(g[1])) for g in info["groups"]]
+    cur_groups = [(g.dtype, g.elems) for g in layout.groups]
+    if saved_groups != cur_groups:
+        raise ValueError(
+            f"sharded checkpoint was saved for dtype groups "
+            f"{saved_groups} but the live parameters pack into "
+            f"{cur_groups}; was the optimizer init()-ed with the same "
+            "parameters?"
+        )
+    if bool(info["master"]) != bool(layout.master):
+        raise ValueError(
+            f"sharded checkpoint was saved with BLUEFOG_SHARD_MASTER="
+            f"{int(info['master'])} but the live setting is "
+            f"{int(layout.master)}; restore under the same master-param "
+            "mode"
+        )
+    leaves, treedef = jax.tree_util.tree_flatten(ref_state)
+    if len(leaves) != int(info["n_leaves"]):
+        raise ValueError(
+            f"sharded checkpoint has {info['n_leaves']} state leaves "
+            f"but the live optimizer builds {len(leaves)}; inner "
+            "transformation changed since save"
+        )
+    slot_map = {int(i): int(gi) for i, gi in info["slot_leaves"]}
+    ctx = ctx_mod.get_context()
+    shr = win_mod._worker_sharding(ctx)
+    saved = payload["opt_state"]
+    out = []
+    for i, ref in enumerate(leaves):
+        arr = np.asarray(saved[f"leaf_{i:03d}"]).astype(ref.dtype)
+        gi = slot_map.get(i)
+        if gi is not None:
+            arr = sharding.slice_rows(arr, layout, gi)
+        elif tuple(arr.shape) != tuple(ref.shape):
+            raise ValueError(
+                f"saved state leaf {i} has shape {tuple(arr.shape)} but "
+                f"the live optimizer expects {tuple(ref.shape)}"
+            )
+        out.append(jax.device_put(arr, shr))
+    return jax.tree_util.tree_unflatten(treedef, out)

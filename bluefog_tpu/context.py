@@ -438,6 +438,11 @@ def shutdown() -> None:
     _attribution.on_shutdown()
     _health.on_shutdown()
     _staleness.on_shutdown()
+    # the shard registry is per-session observability state: a stale
+    # layout summary must not survive into the next init's /fleet
+    from bluefog_tpu import sharding as _sharding
+
+    _sharding.clear_active()
     if _context is not None:
         # session_end lands in the ring (and the crash hooks detach)
         # while the timeline is still open for the clock pairing

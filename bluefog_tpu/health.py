@@ -1126,6 +1126,18 @@ class HealthPlane:
                 rep["async"] = engine.summary()
         except Exception:
             pass
+        # the weight-update shard layout rides here too: an operator
+        # sizing a fleet reads per-rank optimizer-state bytes (measured
+        # + analytic 1/N model) next to the health numbers
+        # (BLUEFOG_SHARD, docs/sharding.md)
+        try:
+            from bluefog_tpu import sharding as sharding_mod
+
+            shard = sharding_mod.summary()
+            if shard is not None:
+                rep["shard"] = shard
+        except Exception:
+            pass
         return rep
 
     def dump(self, path: str) -> str:

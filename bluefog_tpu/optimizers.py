@@ -59,6 +59,7 @@ from bluefog_tpu import attribution
 from bluefog_tpu import autotune as autotune_mod
 from bluefog_tpu import context as ctx_mod
 from bluefog_tpu import flight
+from bluefog_tpu import sharding
 from bluefog_tpu import health as health_mod
 from bluefog_tpu import metrics as metrics_mod
 from bluefog_tpu import staleness as staleness_mod
@@ -66,6 +67,7 @@ from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
 from bluefog_tpu.collective import compiler, inner, ops as col_ops
 from bluefog_tpu.collective.plan import SchedulePlan, plan_from_topology
+from bluefog_tpu.logging_util import warn_once
 from jax.sharding import PartitionSpec as P
 
 __all__ = [
@@ -208,8 +210,85 @@ def _packed_gossip_ef(tree, ef_blocks, ef_combine, cap_bytes=0):
     return jax.tree_util.tree_unflatten(treedef, out), tuple(ef_out)
 
 
+def _shard_check_groups(tree, layout, what):
+    """The packed dtype groups of ``tree`` must be exactly the groups
+    the shard layout was built for — a silent mismatch would slice the
+    wrong coordinates."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    got = tuple(
+        (dt, sum(int(np.prod(leaves[i].shape)) for i in idxs))
+        for dt, idxs in _dtype_groups(leaves)
+    )
+    want = tuple((g.dtype, g.elems) for g in layout.groups)
+    if got != want:
+        raise ValueError(
+            f"BLUEFOG_SHARD: the {what} tree packs into dtype groups "
+            f"{got} but the shard layout was built for {want}; "
+            "gradients must share the parameter tree's dtypes (re-init "
+            "the optimizer state after changing parameter avals)"
+        )
+
+
+def _shard_own_slices(tree, layout, axis):
+    """Each rank's owned 512-aligned slot of every packed dtype group
+    (traced): pack -> pad to the layout grid -> dynamic-slice at this
+    rank's owner index. Dead ranks slice slot 0 — they compute an
+    unused duplicate whose output the gather never selects."""
+    packs = _pack_groups(tree)
+    lidx = jnp.asarray(layout.live_index())
+    i = lidx[jax.lax.axis_index(axis)]
+    out = []
+    for gi, gsh in enumerate(layout.groups):
+        f = jnp.pad(packs[gi], (0, gsh.padded - packs[gi].shape[0]))
+        out.append(
+            jax.lax.dynamic_slice_in_dim(f, i * gsh.slot, gsh.slot)
+        )
+    return tuple(out)
+
+
+def _sharded_inner_update(tx, layout, p, s, g):
+    """The ZeRO-1 weight update (arxiv 2004.13336), valid exactly when
+    the update inputs are rank-invariant (the gradient-allreduce
+    family): each rank updates only its owned slot of the packed
+    parameter vector with its 1/N optax-state shard (optionally against
+    an fp32 master slice), then one ``all_gather`` redistributes the
+    updated slices and the full tree is repacked. Runs inside the
+    shard_map block on UNSTACKED trees; ``s`` is a
+    :class:`bluefog_tpu.sharding.ShardedOptState`. Returns ``(p, s)``.
+    """
+    _shard_check_groups(p, layout, "parameter")
+    _shard_check_groups(g, layout, "gradient")
+    own_p = _shard_own_slices(p, layout, ctx_mod.WORKER_AXIS)
+    own_g = _shard_own_slices(g, layout, ctx_mod.WORKER_AXIS)
+    if layout.master:
+        # fp32 master slices carry the reference values; the update
+        # runs in fp32 and the wire ships the narrowed result
+        own_g = tuple(x.astype(jnp.float32) for x in own_g)
+        updates, inner_s = tx.update(own_g, s.inner, s.master)
+        masters = optax.apply_updates(s.master, updates)
+        new_own = tuple(
+            m.astype(o.dtype) for m, o in zip(masters, own_p)
+        )
+        s_out = sharding.ShardedOptState(inner_s, tuple(masters))
+    else:
+        updates, inner_s = tx.update(own_g, s.inner, own_p)
+        new_own = optax.apply_updates(own_p, updates)
+        s_out = sharding.ShardedOptState(inner_s, ())
+    live_rows = jnp.asarray(np.asarray(layout.live, np.int32))
+    full = []
+    for gi, gsh in enumerate(layout.groups):
+        gathered = jax.lax.all_gather(
+            new_own[gi], ctx_mod.WORKER_AXIS
+        )  # [size, slot]
+        full.append(
+            jnp.take(gathered, live_rows, axis=0).reshape(-1)[:gsh.elems]
+        )
+    return _unpack_groups(p, tuple(full)), s_out
+
+
 def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
-                    ef, ef_state, p, s, g, wire=None, with_metrics=False):
+                    ef, ef_state, p, s, g, wire=None, with_metrics=False,
+                    shard=None):
     """The gossip+inner-update core shared by :meth:`_GossipOptimizer.step`
     and the fused builder (:meth:`_GossipOptimizer.make_train_step`).
 
@@ -271,6 +350,14 @@ def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
         if with_metrics:
             mvec = probe(g, ef_state, allreduce_fn)
         g = _packed_gossip(g, allreduce_fn, step, wops, cap_bytes)
+
+    if shard is not None:
+        # BLUEFOG_SHARD=1: the allreduce above made the gradient
+        # rank-invariant, so the replicated inner update is redundant —
+        # run the ZeRO-1 sharded form instead (1/N state, owned-slot
+        # update, all-gather redistribution). `s` is a ShardedOptState.
+        p, s = _sharded_inner_update(tx, shard, p, s, g)
+        return p, s, ef_state, mvec
 
     def communicate(tree, ef_st):
         nonlocal mvec
@@ -440,6 +527,11 @@ class _GossipOptimizer:
         # for allreduce/empty/hierarchical): the attribution doctor's
         # per-round probes measure exactly this plan's rounds.
         self._last_plan = None
+        # BLUEFOG_SHARD=1 weight-update sharding (docs/sharding.md):
+        # the active ShardLayout (None = replicated state) and the
+        # membership-change re-shard count.
+        self._shard_layout = None
+        self._shard_reshards = 0
 
     @property
     def tx(self):
@@ -458,8 +550,14 @@ class _GossipOptimizer:
     # -- state ---------------------------------------------------------------
 
     def init(self, params):
-        """Per-worker inner-optimizer state, worker-stacked."""
+        """Per-worker inner-optimizer state, worker-stacked. Under
+        ``BLUEFOG_SHARD=1`` (gradient-allreduce family) the state is a
+        worker-stacked :class:`bluefog_tpu.sharding.ShardedOptState`:
+        each rank's 1/N bucket-aligned optax shard plus the optional
+        fp32 master slices (``BLUEFOG_SHARD_MASTER``)."""
         ctx = ctx_mod.get_context()
+        if self._shard_active():
+            return self._shard_init(ctx, params)
         key = ("opt_init", self._uid, self._tx_version) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
@@ -475,6 +573,226 @@ class _GossipOptimizer:
             )
             ctx.op_cache[key] = fn
         return fn(params)
+
+    # -- weight-update sharding (BLUEFOG_SHARD, docs/sharding.md) ------------
+
+    def _shard_active(self) -> bool:
+        """Sharding applies where it is trajectory-exact: the family
+        whose post-communication update inputs are rank-invariant
+        (order='grad', the arxiv 2004.13336 setting). Every other
+        family holds genuinely per-rank state — already 1/N of the
+        fleet total, nothing redundant to shard — so the knob warns
+        once and the replicated path runs verbatim (bitwise, pinned in
+        tests/test_sharding.py)."""
+        if not sharding.enabled():
+            return False
+        if self.order == "grad" and self.schedule is None:
+            return True
+        warn_once(
+            f"shard-family:{self.order}:{self.communication_type.value}",
+            "BLUEFOG_SHARD=1 ignored for the %s/%s family: its optax "
+            "state integrates each rank's own gradient stream (per-rank "
+            "by construction, no cross-rank redundancy), so a "
+            "coordinate-partitioned update would change the algorithm. "
+            "Running the replicated path verbatim; weight-update "
+            "sharding applies to the gradient-allreduce family "
+            "(docs/sharding.md).",
+            self.order, self.communication_type.value,
+        )
+        return False
+
+    def _shard_groups(self, params):
+        """``[(dtype, elems)]`` of the worker-stacked parameter tree in
+        packed-wire order — the grain the shard layout is built on."""
+        leaves = jax.tree_util.tree_leaves(params)
+        return tuple(
+            (dt, sum(int(np.prod(leaves[i].shape[1:])) for i in idxs))
+            for dt, idxs in _dtype_groups(leaves)
+        )
+
+    def _ensure_shard_layout(self, ctx, params):
+        """Resolve the current shard layout; returns ``(layout,
+        changed)`` where ``changed`` means the stored layout no longer
+        matches the live set / parameter avals (the caller must
+        re-shard any existing state)."""
+        token = ctx.live_token()
+        groups = self._shard_groups(params)
+        master = sharding.master_enabled()
+        lay = self._shard_layout
+        if (
+            lay is not None
+            and lay.token == token
+            and lay.master == master
+            and tuple((g.dtype, g.elems) for g in lay.groups) == groups
+        ):
+            return lay, False
+        live = token[1] if token is not None else tuple(range(ctx.size))
+        new = sharding.build_layout(
+            groups, live, ctx.size, master=master, token=token
+        )
+        changed = lay is not None
+        self._shard_layout = new
+        return new, changed
+
+    def _shard_check_elementwise(self, ctx):
+        """Refuse inner transformations with cross-coordinate coupling
+        (global-norm clipping, LARS/LAMB trust ratios): their update of
+        a slot depends on coordinates the slot's owner never sees, so
+        sharding would silently train a different trajectory — the one
+        failure mode docs/sharding.md promises cannot happen.
+
+        Detection is behavioral, not by type: update a small vector
+        twice with identical values in the probe region and different
+        values outside it. An elementwise transform yields bit-equal
+        probe-region updates; a coupled one almost surely differs."""
+        key = ("shard_elementwise", self._uid, self._tx_version)
+        ok = ctx.op_cache.get(key)
+        if ok is None:
+            d = 2 * sharding.ALIGN_ELEMS
+            half = d // 2
+            rng = np.random.RandomState(0)
+            p = rng.randn(d).astype(np.float32)
+            g1 = rng.randn(d).astype(np.float32)
+            g2 = g1.copy()
+            g2[half:] = rng.randn(half).astype(np.float32)
+            s0 = self._tx.init(p)
+            u1, _ = self._tx.update(g1, s0, p)
+            u2, _ = self._tx.update(g2, self._tx.init(p), p)
+            ok = bool(
+                np.array_equal(
+                    np.asarray(u1)[:half], np.asarray(u2)[:half]
+                )
+            )
+            ctx.op_cache[key] = ok
+        if not ok:
+            raise ValueError(
+                "BLUEFOG_SHARD=1 requires an ELEMENTWISE inner "
+                "transformation: this optimizer's update of a "
+                "coordinate depends on other coordinates (global-norm "
+                "clipping, LARS/LAMB-style trust ratios, ...), so a "
+                "1/N-slot update would silently diverge from the "
+                "replicated trajectory. Use an elementwise transform "
+                "(adam, sgd, rmsprop, adagrad, per-element clipping) "
+                "or run with BLUEFOG_SHARD=0 (docs/sharding.md)"
+            )
+
+    def _shard_init(self, ctx, params):
+        self._shard_check_elementwise(ctx)
+        layout, _ = self._ensure_shard_layout(ctx, params)
+        key = (
+            "opt_shard_init", self._uid, self._tx_version,
+        ) + layout.sig() + _aval_key(params)
+        fn = ctx.op_cache.get(key)
+        if fn is None:
+            tx = self._tx
+
+            def body(p_b):
+                p = _tree_block(p_b)
+                own = _shard_own_slices(p, layout, ctx_mod.WORKER_AXIS)
+                master = (
+                    tuple(x.astype(jnp.float32) for x in own)
+                    if layout.master else ()
+                )
+                return _tree_restack(
+                    sharding.ShardedOptState(tx.init(own), master)
+                )
+
+            spec = P(ctx_mod.WORKER_AXIS)
+            fn = jax.jit(
+                jax.shard_map(
+                    body, mesh=ctx.mesh, in_specs=spec, out_specs=spec
+                )
+            )
+            ctx.op_cache[key] = fn
+        state = fn(params)
+        self._register_shard(layout, state)
+        return state
+
+    def _register_shard(self, layout, state) -> None:
+        from bluefog_tpu import scaling
+
+        sharding.register_active(
+            layout, reshards=self._shard_reshards,
+            measured_state_bytes=scaling.optimizer_state_bytes(
+                state=state, world=layout.size
+            ),
+        )
+
+    @staticmethod
+    def _shard_slot_group(arr_shape, layout):
+        """The group index a worker-stacked state leaf of ``arr_shape``
+        belongs to, or None for non-slot (scalar/replicated) leaves.
+        Slot lengths are unique per layout (sharding.build_layout), so
+        the trailing dimension is an unambiguous discriminator."""
+        if len(arr_shape) != 2 or arr_shape[0] != layout.size:
+            return None
+        for gi, g in enumerate(layout.groups):
+            if arr_shape[1] == g.slot:
+                return gi
+        return None
+
+    def _reshard_state(self, ctx, old, new, opt_state):
+        """Host-side membership-change re-shard: reconstruct each
+        per-coordinate state group from its old owners' rows (the
+        worker-stacked simulation holds every row; a real fleet would
+        source a lost shard from the gather-on-save checkpoint — see
+        docs/sharding.md) and re-slice it under the new owner map.
+        Non-slot leaves (step counts) are replicated and carried over.
+        """
+        from jax.sharding import NamedSharding
+
+        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        nd_sharding = NamedSharding(ctx.mesh, P(ctx_mod.WORKER_AXIS))
+        out = []
+        for leaf in leaves:
+            gi = self._shard_slot_group(tuple(leaf.shape), old)
+            if gi is None:
+                out.append(leaf)
+                continue
+            full = sharding.gather_rows(np.asarray(leaf), old, gi)
+            out.append(jax.device_put(
+                sharding.slice_rows(full, new, gi), nd_sharding
+            ))
+        self._shard_reshards += 1
+        metrics_mod.counter("bluefog.shard.reshards").inc()
+        flight.record(
+            "shard_reshard", live=len(new.live), was=len(old.live),
+        )
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _shard_prepare(self, ctx, params, opt_state):
+        """Per-dispatch shard prologue: resolve the layout against the
+        current live set and re-shard the state on a membership change
+        — the compiled-step cache key carries the layout signature, so
+        a stale layout can never dispatch."""
+        if not isinstance(opt_state, sharding.ShardedOptState):
+            raise ValueError(
+                "BLUEFOG_SHARD=1 but the optimizer state is not sharded "
+                "(was it created with BLUEFOG_SHARD=0, or restored from "
+                "a replicated checkpoint?); re-run init(params) or "
+                "restore a gather-on-save sharded checkpoint"
+            )
+        # re-checked per tx_version: rebinding opt.tx after init must
+        # not smuggle a coupled transform past the init-time probe
+        self._shard_check_elementwise(ctx)
+        old = self._shard_layout
+        layout, changed = self._ensure_shard_layout(ctx, params)
+        if changed:
+            if old.master != layout.master:
+                # a reshard can re-lay slot leaves but cannot invent
+                # (or drop) the fp32 master slices mid-run; without
+                # this the mismatch surfaces as an opaque pytree error
+                # deep inside the jitted trace
+                raise ValueError(
+                    "BLUEFOG_SHARD_MASTER changed mid-run (was "
+                    f"{int(old.master)}, now {int(layout.master)}); "
+                    "the master slices are part of the optimizer "
+                    "state — re-run init(params) (or restore a "
+                    "checkpoint saved under the same master mode)"
+                )
+            opt_state = self._reshard_state(ctx, old, layout, opt_state)
+            self._register_shard(layout, opt_state)
+        return layout, opt_state
 
     # -- gossip resolution ---------------------------------------------------
 
@@ -959,11 +1277,14 @@ class _GossipOptimizer:
             self._fold_pending(self._pending_drain, export=False)
             self._pending_drain = None
 
-    def _record_comm_accounting(self, key, gossip_key, params, ctx):
+    def _record_comm_accounting(self, key, gossip_key, params, ctx,
+                                shard=None):
         """Host-tier per-dispatch accounting: ppermute rounds and wire
         bytes for this communicating step (static per compiled program,
         so the numbers are computed once per cache key). TopoOpt-style
-        per-edge traffic planning starts from exactly this counter."""
+        per-edge traffic planning starts from exactly this counter.
+        An active shard layout adds its all-gather redistribution bytes
+        and publishes the ``bluefog.shard.*`` gauges."""
         acct = self._acct_cache.get(key)
         if acct is None:
             tag = gossip_key[0]
@@ -1005,12 +1326,28 @@ class _GossipOptimizer:
                 wire_bytes = metrics_mod.wire_bytes_per_step(
                     by_item, rounds, wire
                 )
+            if shard is not None:
+                # the sharded step ships the updated slices back over
+                # the fabric: price the all-gather with the gossip wire
+                wire_bytes += sharding.gather_wire_bytes(shard)
             acct = (rounds, wire_bytes)
             self._acct_cache[key] = acct
         rounds, wire_bytes = acct
         metrics_mod.gauge("bluefog.gossip.rounds").set(rounds)
         metrics_mod.counter("bluefog.wire_bytes").inc(wire_bytes)
         metrics_mod.counter("bluefog.comm_steps").inc()
+        if shard is not None:
+            metrics_mod.gauge("bluefog.shard.enabled").set(1)
+            metrics_mod.gauge("bluefog.shard.state_bytes").set(
+                sharding.state_bytes(shard)
+            )
+            metrics_mod.gauge("bluefog.shard.ratio").set(
+                sharding.state_bytes(shard)
+                / max(sharding.state_bytes(shard, sharded=False), 1)
+            )
+            metrics_mod.counter("bluefog.shard.gather_bytes").inc(
+                sharding.gather_wire_bytes(shard)
+            )
 
     def step(self, params, opt_state, grads):
         """One decentralized optimization step; returns (params, opt_state).
@@ -1033,6 +1370,9 @@ class _GossipOptimizer:
         (
             hier, mesh, spec, gossip_key, gossip_fn, wops, ef, cap_bytes,
         ) = self._resolve_dispatch(ctx, params, comm_now)
+        shard_l = None
+        if comm_now and self._shard_active():
+            shard_l, opt_state = self._shard_prepare(ctx, params, opt_state)
         met_enabled = metrics_mod.enabled() and comm_now
         # Two-program sampling: only the 1-in-interval sampled step pays
         # the metric computation — every other step dispatches a program
@@ -1047,7 +1387,12 @@ class _GossipOptimizer:
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
             self._tx_version, ef, cap_bytes, met,
-        ) + tuple(gossip_key) + _aval_key(params)
+        ) + tuple(gossip_key) + (
+            # BLUEFOG_SHARD=0 leaves the key verbatim (bitwise shard-off
+            # pin); an active layout keys on its full signature so a
+            # membership change can never dispatch a stale owner map
+            shard_l.sig() if shard_l is not None else ()
+        ) + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
             metrics_mod.counter("bluefog.recompiles").inc()
@@ -1064,6 +1409,7 @@ class _GossipOptimizer:
                 p, s, ef_out, mvec = _combine_update(
                     order, tx, gossip_fn, wops, step, cap_bytes,
                     ef, ef_in, p, s, g, wire=wire_now, with_metrics=met,
+                    shard=shard_l,
                 )
                 ef_out = tuple(
                     (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
@@ -1095,7 +1441,9 @@ class _GossipOptimizer:
             self._comm_count += 1
         ef_in = self._ef if ef else ()
         if met_enabled:
-            self._record_comm_accounting(key, gossip_key, params, ctx)
+            self._record_comm_accounting(
+                key, gossip_key, params, ctx, shard=shard_l
+            )
         doc_t0 = attribution.dispatch_timer(comm_now)
         params_out, opt_state, ef_out, met_out = _timed_dispatch(
             "optimizer_step", fn, params, opt_state, grads, step_idx, wops,
@@ -1246,6 +1594,11 @@ class _GossipOptimizer:
                 hier, mesh, spec, gossip_key, gossip_fn, wops, ef,
                 cap_bytes,
             ) = self._resolve_dispatch(ctx, params, comm_now)
+            shard_l = None
+            if comm_now and self._shard_active():
+                shard_l, opt_state = self._shard_prepare(
+                    ctx, params, opt_state
+                )
             if delayed and hier:
                 raise ValueError(
                     "delayed=True is not supported for hierarchical "
@@ -1275,7 +1628,11 @@ class _GossipOptimizer:
                 "opt_fused_step", fused_uid, self.order,
                 self.communication_type, self._uid, self._tx_version, ef,
                 delay_now, cap_bytes, accum is not None, met,
-            ) + tuple(gossip_key) + _aval_key((params, opt_state, batch))
+            ) + tuple(gossip_key) + (
+                # same shard-key discipline as step(): absent when off
+                # (bitwise pin), full layout signature when on
+                shard_l.sig() if shard_l is not None else ()
+            ) + _aval_key((params, opt_state, batch))
             fn = ctx.op_cache.get(key)
             if fn is None:
                 metrics_mod.counter("bluefog.recompiles").inc()
@@ -1390,6 +1747,7 @@ class _GossipOptimizer:
                             order, tx, gossip_fn, wops, step, cap_bytes,
                             ef, ef_in, p, s, grads,
                             wire=wire_now, with_metrics=met,
+                            shard=shard_l,
                         )
                         ef_out = tuple(
                             (jnp.expand_dims(sb, 0),
@@ -1440,7 +1798,9 @@ class _GossipOptimizer:
             buf_in = self._delay_buf if delay_now else ()
             accum_in = accum if accum is not None else ()
             if met_enabled:
-                self._record_comm_accounting(key, gossip_key, params, ctx)
+                self._record_comm_accounting(
+                    key, gossip_key, params, ctx, shard=shard_l
+                )
             # single source of truth for debug/evidence lowering
             # (lower_last_fused_hlo): the compiled fn plus exactly the
             # operand structure this dispatch used — as avals, not live

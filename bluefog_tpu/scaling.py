@@ -60,6 +60,7 @@ __all__ = [
     "plan_comm_summary",
     "wire_payload_bytes",
     "wire_bytes_per_step",
+    "optimizer_state_bytes",
     "LINEAGE_TAG_BYTES",
     "ring_allreduce_cost",
     "one_peer_gossip_cost",
@@ -144,6 +145,100 @@ def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
         for itemsize, n in n_elems_by_itemsize.items()
     ) + (LINEAGE_TAG_BYTES if lineage else 0)
     return per_round * n_rounds
+
+def _leaf_bytes(leaf) -> int:
+    """Bytes of one array-like leaf (works on jax/numpy arrays and
+    ShapeDtypeStructs alike)."""
+    return int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+
+
+def _named_dtype(name: str):
+    """dtype instance for a ``str(jnp.result_type(...))`` name —
+    extension dtypes (bfloat16) are not in numpy's string registry."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def optimizer_state_bytes(
+    params=None,
+    opt=None,
+    *,
+    shard: bool = False,
+    master: Optional[bool] = None,
+    live: Optional[Sequence[int]] = None,
+    state=None,
+    world: Optional[int] = None,
+) -> int:
+    """Canonical PER-RANK optimizer-state byte accounting — the single
+    number the shard evidence (``BENCH_MODE=shard``), the health
+    ``/fleet`` report's shard block, and ``tools/shard_plan.py`` all
+    quote (docs/sharding.md).
+
+    Two modes:
+
+    - **measured**: pass ``state=`` (a live worker-stacked state tree)
+      — returns the real allocated bytes divided by the worker count
+      (``world=``, default inferred from the leading axis). This is
+      what SHARD_EVIDENCE.json's 1/N claim is gated on: actual array
+      bytes, not a model.
+    - **analytic**: pass ``params`` (worker-stacked) and ``opt`` (a
+      distributed optimizer or a raw optax transformation) — sizes the
+      state via ``jax.eval_shape`` of ``tx.init`` without allocating
+      anything. ``shard=True`` prices the bucket-aligned 1/N shard of
+      :mod:`bluefog_tpu.sharding` instead of the replicated tree
+      (``master=`` adds the fp32 master slices; defaults to
+      ``BLUEFOG_SHARD_MASTER``; ``live=`` restricts the owner set,
+      default all ranks).
+    """
+    from bluefog_tpu import sharding
+
+    if state is not None:
+        leaves = jax.tree_util.tree_leaves(state)
+        if not leaves:
+            return 0
+        n = int(world) if world else int(leaves[0].shape[0])
+        return sum(_leaf_bytes(l) for l in leaves) // max(n, 1)
+    if params is None or opt is None:
+        raise ValueError(
+            "optimizer_state_bytes needs either state= (measured) or "
+            "params + opt (analytic)"
+        )
+    tx = getattr(opt, "tx", opt)
+    leaves = jax.tree_util.tree_leaves(params)
+    size = int(leaves[0].shape[0])
+    if not shard:
+        blocks = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(tuple(l.shape[1:]), l.dtype),
+            params,
+        )
+        st = jax.eval_shape(tx.init, blocks)
+        return sum(_leaf_bytes(l) for l in jax.tree_util.tree_leaves(st))
+    if master is None:
+        master = sharding.master_enabled()
+    groups = []
+    by_dtype: Dict[str, int] = {}
+    for l in leaves:
+        dt = str(jnp.result_type(l))
+        by_dtype[dt] = by_dtype.get(dt, 0) + int(np.prod(l.shape[1:]))
+    groups = sorted(by_dtype.items())
+    layout = sharding.build_layout(
+        groups, live if live is not None else range(size), size,
+        master=master,
+    )
+    slices = tuple(
+        jax.ShapeDtypeStruct((g.slot,), _named_dtype(g.dtype))
+        for g in layout.groups
+    )
+    st = jax.eval_shape(tx.init, slices)
+    total = sum(_leaf_bytes(l) for l in jax.tree_util.tree_leaves(st))
+    if master:
+        total += sum(4 * g.slot for g in layout.groups)
+    return total
+
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,

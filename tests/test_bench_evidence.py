@@ -949,3 +949,92 @@ def test_staleness_evidence_file_committed():
     assert chaos[0]["injected_edge"] in chaos[0]["edges_named"]
     anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
     assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_shard_evidence_file_committed():
+    """SHARD_EVIDENCE.json (the committed BENCH_MODE=shard output)
+    carries the acceptance facts: measured per-rank Adam state bytes at
+    1/N (+ the disclosed 512-alignment slack) on an 8-worker mesh, for
+    a model whose REPLICATED state exceeds the simulated per-chip
+    budget the sharded run trains under; the sharded trajectory
+    matching both the replicated path and the numpy Adam oracle; step
+    time within the disclosed A/A noise floor of unsharded; and the
+    BLUEFOG_SHARD=0 bitwise pin with zero shard-tagged cache keys —
+    plus provenance and the ambient anchor."""
+    path = os.path.join(REPO, "SHARD_EVIDENCE.json")
+    assert os.path.exists(path), "SHARD_EVIDENCE.json missing"
+    lines = [
+        json.loads(l) for l in open(path).read().splitlines()
+        if l.startswith("{")
+    ]
+    _assert_provenance(lines)
+    mem = [l for l in lines if l.get("metric") == "shard_memory"]
+    assert mem, lines
+    m = mem[0]
+    assert m["workers"] == 8
+    assert m["replicated_exceeds_budget"] is True
+    assert m["sharded_fits_budget"] is True
+    assert m["state_bytes_sharded"] <= m["budget_bytes"]
+    assert m["state_bytes_replicated"] > m["budget_bytes"]
+    # 1/N + bucket-padding slack: the slot/dim ratio IS that bound
+    bound = (
+        m["state_bytes_replicated"] * (m["slot_elems"] / m["dim"]) * 1.02
+        + 4096
+    )
+    assert m["state_bytes_sharded"] <= bound, (m, bound)
+    assert m["shard_ratio"] < 0.2  # well under 1/8 + slack at N=8
+    assert m["loss_end"] < 0.5 * m["loss_start"]
+    assert m["replica_spread"] == 0.0
+    assert m["gather_bytes_per_step"] > 0
+    traj = [l for l in lines if l.get("metric") == "shard_trajectory"]
+    assert traj, lines
+    assert traj[0]["sharded_matches_replicated"] is True
+    assert traj[0]["sharded_matches_numpy_oracle"] is True
+    assert traj[0]["traj_max_dev"] <= traj[0]["tol"]
+    t = [l for l in lines if l.get("metric") == "shard_step_time"]
+    assert t, lines
+    assert t[0]["within_noise"] is True
+    assert t[0]["aa_noise_pct"] >= 0  # the floor is disclosed
+    assert abs(t[0]["delta_pct"]) <= t[0]["noise_bound_pct"]
+    off = [l for l in lines if l.get("metric") == "shard_off_pin"]
+    assert off, lines
+    assert off[0]["bitwise_identical"] is True
+    assert off[0]["shard_tagged_cache_keys"] == 0
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_shard_columns_are_tooling_gained(tmp_path):
+    """The shard evidence adds state-byte/layout accounting columns;
+    against a pre-shard artifact their one-sided appearance must read
+    as tooling-gained-a-column, never a timing-harness break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_shard_cols):
+        row = {
+            "metric": "gossip_step", "n_workers": 8,
+            "ms_per_step": 10.0, "median": 10.1, "min": 9.9,
+        }
+        if with_shard_cols:
+            row["state_bytes_replicated"] = 2097164
+            row["state_bytes_sharded"] = 266244
+            row["shard_ratio"] = 0.127
+            row["gather_bytes_per_step"] = 931840
+        path.write_text(
+            json.dumps(prov) + "\n" + json.dumps(row) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell

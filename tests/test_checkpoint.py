@@ -11,6 +11,7 @@ p lane) and the step counter that drives dynamic schedules.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -349,12 +350,15 @@ def test_restore_pre_graph_info_checkpoint_still_loads(tmp_path):
     (no spurious refusal on legacy data)."""
     params = {"w": bf.worker_values(lambda r: targets()[r])}
     target = ckpt.save(str(tmp_path), 3, params, {})
-    # simulate a legacy checkpoint by stripping the block
+    # simulate a legacy checkpoint by stripping the block (and the
+    # graph-info sidecar that now also carries it)
     payload = ckpt._checkpointer().restore(target)
     payload.pop("graph_info", None)
+    import os as _os
     import shutil
 
     shutil.rmtree(target)
+    _os.remove(str(tmp_path / "3.graph.json"))
     ckpt._checkpointer().save(target, payload, force=True)
     bf.set_topology(tu.RingGraph(SIZE))  # would mismatch, if recorded
     step, p, s = ckpt.restore(str(tmp_path))
@@ -384,3 +388,116 @@ def test_restore_superset_live_set_revives_under_elastic(tmp_path):
         bf.get_context().load_topology()
     ) != digest_dead
     bf.elastic.stop()
+
+
+# -- weight-update sharding (BLUEFOG_SHARD, docs/sharding.md) ----------------
+
+
+SHARD_DIM = 1100
+
+
+def _shard_grad_run(monkeypatch, steps, params=None, state=None, opt=None):
+    monkeypatch.setenv("BLUEFOG_SHARD", "1")
+    c = np.random.RandomState(7).randn(SIZE, SHARD_DIM).astype(np.float32)
+    if opt is None:
+        opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+        params = {"w": bf.worker_values(
+            lambda r: np.zeros(SHARD_DIM, np.float32)
+        )}
+        state = opt.init(params)
+    for _ in range(steps):
+        params, state = opt.step(
+            params, state, {"w": params["w"] - jnp.asarray(c)}
+        )
+    return opt, params, state, c
+
+
+def test_sharded_checkpoint_resume_bit_exact(tmp_path, monkeypatch):
+    """Gather-on-save: the sharded state round-trips through the
+    layout-independent checkpoint form and the resumed trajectory is
+    bit-exact against the uninterrupted one."""
+    opt, params, state, c = _shard_grad_run(monkeypatch, 3)
+    ckpt.save(str(tmp_path), 3, params, state, optimizer=opt)
+    # the payload's state leaves are FULL vectors, not slot rows
+    p_ref, s_ref = params, state
+    for _ in range(3):
+        p_ref, s_ref = opt.step(
+            p_ref, s_ref, {"w": p_ref["w"] - jnp.asarray(c)}
+        )
+    opt2 = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+    step, p2, s2 = ckpt.restore(str(tmp_path), optimizer=opt2)
+    assert step == 3
+    from bluefog_tpu import sharding
+
+    assert isinstance(s2, sharding.ShardedOptState)
+    for _ in range(3):
+        p2, s2 = opt2.step(p2, s2, {"w": p2["w"] - jnp.asarray(c)})
+    np.testing.assert_array_equal(
+        np.asarray(p2["w"]), np.asarray(p_ref["w"])
+    )
+
+
+def test_sharded_checkpoint_refusals(tmp_path, monkeypatch):
+    """Mismatch = refusal with the reason, never a silent re-layout:
+    sharded checkpoint + sharding off, replicated checkpoint + sharding
+    on, and a flipped master knob all fail with clear messages."""
+    opt, params, state, _c = _shard_grad_run(monkeypatch, 1)
+    ckpt.save(str(tmp_path / "sharded"), 1, params, state, optimizer=opt)
+    monkeypatch.setenv("BLUEFOG_SHARD", "0")
+    opt_off = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+    with pytest.raises(ValueError, match="BLUEFOG_SHARD=1"):
+        ckpt.restore(str(tmp_path / "sharded"), optimizer=opt_off)
+    # replicated checkpoint, shard-active restore
+    state_off = opt_off.init(params)
+    ckpt.save(str(tmp_path / "plain"), 1, params, state_off,
+              optimizer=opt_off)
+    monkeypatch.setenv("BLUEFOG_SHARD", "1")
+    opt_on = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+    with pytest.raises(ValueError, match="REPLICATED"):
+        ckpt.restore(str(tmp_path / "plain"), optimizer=opt_on)
+    # master-knob flip
+    monkeypatch.setenv("BLUEFOG_SHARD_MASTER", "1")
+    opt_m = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+    with pytest.raises(ValueError, match="SHARD_MASTER"):
+        ckpt.restore(str(tmp_path / "sharded"), optimizer=opt_m)
+
+
+def test_restore_prevalidates_graph_before_allocating(tmp_path,
+                                                      monkeypatch):
+    """The elastic-repair ride-along bugfix: a live-set/world mismatch
+    must fail on the graph-info SIDECAR — before orbax materializes a
+    single state buffer — with the clear message, not a shape error
+    mid-restore."""
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    state = opt.init(params)
+    ckpt.save(str(tmp_path), 2, params, state, optimizer=opt)
+    import os as _os
+
+    assert _os.path.exists(str(tmp_path / "2.graph.json"))
+    bf.shutdown()
+    bf.init(devices=jax.devices("cpu")[:4])  # wrong world size
+
+    def boom():
+        raise AssertionError(
+            "orbax restore ran before graph validation — state buffers "
+            "were allocated for a mismatched graph"
+        )
+
+    monkeypatch.setattr(ckpt, "_checkpointer", boom)
+    opt2 = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="8-worker mesh"):
+        ckpt.restore(str(tmp_path), optimizer=opt2)
+
+
+def test_restore_without_sidecar_still_validates(tmp_path):
+    """Checkpoints predating the sidecar keep the post-load check."""
+    params = {"w": bf.worker_values(lambda r: targets()[r])}
+    ckpt.save(str(tmp_path), 1, params, {})
+    import os as _os
+
+    _os.remove(str(tmp_path / "1.graph.json"))
+    bf.shutdown()
+    bf.init(devices=jax.devices("cpu")[:4])
+    with pytest.raises(ValueError, match="8-worker mesh"):
+        ckpt.restore(str(tmp_path))

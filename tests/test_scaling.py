@@ -175,3 +175,34 @@ def test_hlo_stats_unknown_dtype_falls_back_not_zero():
     txt = "  %cp = f4e2m1[64]{0} collective-permute(%x)\n"
     stats = scaling.hlo_collective_stats(txt)
     assert stats["collective-permute"]["bytes"] == 64 * 4, stats
+
+
+def test_optimizer_state_bytes_analytic():
+    """The canonical accounting helper (docs/sharding.md): replicated =
+    eval_shape of tx.init (no allocation); sharded = the bucket-aligned
+    1/N shard, fp32 master priced on top. Adam on D params: 2 x 4D
+    state bytes + the int32 count scalar."""
+    import optax
+
+    from bluefog_tpu import scaling, sharding
+
+    d = 10_000
+    n = 8
+    params = {"w": jnp.zeros((n, d), jnp.float32)}
+    tx = optax.adam(1e-3)
+    rep = scaling.optimizer_state_bytes(params, tx)
+    assert rep == 2 * 4 * d + 4  # mu + nu + int32 count
+    sh = scaling.optimizer_state_bytes(params, tx, shard=True)
+    lay = sharding.build_layout([("float32", d)], range(n), n)
+    assert sh == 2 * 4 * lay.groups[0].slot + 4
+    shm = scaling.optimizer_state_bytes(
+        params, tx, shard=True, master=True
+    )
+    assert shm == sh + 4 * lay.groups[0].slot
+    # live subset: fewer owners, bigger slots
+    sh5 = scaling.optimizer_state_bytes(
+        params, tx, shard=True, live=range(5)
+    )
+    assert sh5 > sh
+    with pytest.raises(ValueError, match="state="):
+        scaling.optimizer_state_bytes()

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Print a weight-update shard plan without running a step.
+
+The planning half of ``BLUEFOG_SHARD=1`` (docs/sharding.md): given a
+model's packed dtype groups, a worker count, and optionally a live
+subset, this prints the bucket-aligned owner map
+(:func:`bluefog_tpu.sharding.build_layout`), per-rank optimizer-state
+bytes (replicated vs sharded, fp32 master option), and the
+redistribution wire cost of the post-update all-gather — so an operator
+can answer "does this model's optimizer state fit the chip, and what
+does redistribution cost" before touching a mesh.
+
+Usage::
+
+    python tools/shard_plan.py --workers 8 --group float32:25000000
+    python tools/shard_plan.py --workers 8 --group float32:1048576 \
+        --group bfloat16:524288 --live 0,1,2,4 --master \
+        --budget 16777216 --json
+
+``--slots`` is the number of per-coordinate state copies the inner
+transformation keeps (Adam: mu + nu = 2, SGD-momentum: 1). No jax
+import, no live mesh needed — the layout module is loaded by file path
+so even the package facade (which initializes jax) stays out of the
+way.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_sharding():
+    """Load bluefog_tpu/sharding.py WITHOUT importing the package
+    facade (which pulls jax): the layout math is stdlib+numpy."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bluefog_tpu", "sharding.py",
+    )
+    spec = importlib.util.spec_from_file_location("_bf_sharding", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _parse_group(s: str):
+    try:
+        dt, n = s.split(":")
+        return dt, int(n)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--group wants DTYPE:ELEMS (e.g. float32:1048576), got {s!r}"
+        )
+
+
+def _parse_live(s: str):
+    return [int(r) for r in s.split(",") if r.strip() != ""]
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n} B"
+
+
+def build_report(args) -> dict:
+    sharding = _load_sharding()
+    live = args.live if args.live is not None else list(range(args.workers))
+    layout = sharding.build_layout(
+        args.group, live, args.workers, master=args.master
+    )
+    replicated = sharding.state_bytes(layout, args.slots, sharded=False)
+    sharded = sharding.state_bytes(layout, args.slots, sharded=True)
+    report = {
+        "workers": args.workers,
+        "live": list(layout.live),
+        "n_live": len(layout.live),
+        "slots_per_param": args.slots,
+        "master": args.master,
+        "groups": [
+            {
+                "group": gi,
+                "dtype": g.dtype,
+                "elems": g.elems,
+                "slot_elems": g.slot,
+                "padded_elems": g.padded,
+                "pad_ratio": round(g.padded / g.elems - 1.0, 6),
+            }
+            for gi, g in enumerate(layout.groups)
+        ],
+        "owner_map": layout.owner_map(),
+        "state_bytes_replicated": replicated,
+        "state_bytes_sharded": sharded,
+        "shard_ratio": round(sharded / replicated, 6) if replicated else 1.0,
+        "gather_bytes_per_step": sharding.gather_wire_bytes(layout),
+        "gather_bytes_per_step_live_only": sharding.gather_wire_bytes(
+            layout, live_only=True
+        ),
+    }
+    if args.budget is not None:
+        report["budget_bytes"] = args.budget
+        report["replicated_fits"] = replicated <= args.budget
+        report["sharded_fits"] = sharded <= args.budget
+    return report
+
+
+def print_report(rep: dict) -> None:
+    print(
+        f"shard plan: {rep['n_live']} live of {rep['workers']} workers, "
+        f"{rep['slots_per_param']} state slot(s)/param"
+        + (", fp32 master" if rep["master"] else "")
+    )
+    for g in rep["groups"]:
+        print(
+            f"  group {g['group']} [{g['dtype']}]: {g['elems']} elems -> "
+            f"slot {g['slot_elems']} (padded {g['padded_elems']}, "
+            f"+{100 * g['pad_ratio']:.2f}%)"
+        )
+    print("  owner map (rank: [start, stop) +padding):")
+    for row in rep["owner_map"]:
+        print(
+            f"    g{row['group']} rank {row['rank']}: "
+            f"[{row['start']}, {row['stop']})"
+            + (f" +{row['padding']} pad" if row["padding"] else "")
+        )
+    print(
+        "  per-rank optimizer state: replicated "
+        f"{_fmt_bytes(rep['state_bytes_replicated'])} -> sharded "
+        f"{_fmt_bytes(rep['state_bytes_sharded'])} "
+        f"(x{rep['shard_ratio']:.4f})"
+    )
+    print(
+        "  redistribution per step: "
+        f"{_fmt_bytes(rep['gather_bytes_per_step'])} per rank "
+        f"({_fmt_bytes(rep['gather_bytes_per_step_live_only'])} "
+        "live-only ideal)"
+    )
+    if "budget_bytes" in rep:
+        print(
+            f"  budget {_fmt_bytes(rep['budget_bytes'])}: replicated "
+            f"{'FITS' if rep['replicated_fits'] else 'EXCEEDS'}, "
+            f"sharded {'FITS' if rep['sharded_fits'] else 'EXCEEDS'}"
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=(
+            "Print the BLUEFOG_SHARD owner map, per-rank optimizer-"
+            "state bytes, and redistribution wire cost for a model/"
+            "topology — without running a step (docs/sharding.md)."
+        )
+    )
+    ap.add_argument("--workers", type=int, required=True,
+                    help="mesh size N")
+    ap.add_argument("--group", type=_parse_group, action="append",
+                    required=True, metavar="DTYPE:ELEMS",
+                    help="packed dtype group (repeatable)")
+    ap.add_argument("--live", type=_parse_live, default=None,
+                    help="comma list of live ranks (default: all)")
+    ap.add_argument("--slots", type=int, default=2,
+                    help="per-coordinate state copies (Adam=2)")
+    ap.add_argument("--master", action="store_true",
+                    help="price the fp32 master slices "
+                         "(BLUEFOG_SHARD_MASTER=1)")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="simulated per-chip optimizer-state byte "
+                         "budget to verdict against")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    rep = build_report(args)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=1)
+        print()
+    else:
+        print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
