@@ -95,6 +95,7 @@ from bluefog_tpu import attribution
 from bluefog_tpu import attribution as doctor  # bf.doctor facade
 from bluefog_tpu import autotune
 from bluefog_tpu import health
+from bluefog_tpu import memory
 from bluefog_tpu import sharding
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
@@ -350,6 +351,7 @@ __all__ = [
     "autotune",
     "health",
     "sharding",
+    "memory",
     "staleness",
     "metrics",
     "metrics_snapshot",

@@ -154,10 +154,9 @@ def async_max_age() -> int:
     healthy cadence spread produces but below the 10x-dilation chaos
     scenario, so the gate engages exactly when a genuine straggler
     appears."""
-    try:
-        return max(1, int(os.environ.get(MAX_AGE_ENV, "8")))
-    except ValueError:
-        return 8
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(MAX_AGE_ENV, 8))
 
 
 def async_stale_policy() -> str:

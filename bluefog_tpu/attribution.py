@@ -158,7 +158,9 @@ def doctor_interval() -> int:
     default keeps the amortized cost under the 1 % acceptance bound
     re-checked by ``BENCH_MODE=attribution``; shrink it when actively
     chasing a regression."""
-    return max(1, int(os.environ.get(INTERVAL_ENV, "100")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(INTERVAL_ENV, 100))
 
 
 def probe_elems_cap() -> int:
@@ -167,7 +169,9 @@ def probe_elems_cap() -> int:
     enough that the beta term is visible against dispatch latency,
     small enough that a sample stays cheap. Probe times are scaled to
     the actual wire payload through the calibrated alpha-beta model."""
-    return max(512, int(os.environ.get(PROBE_ELEMS_ENV, str(1 << 15))))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(512, env_int(PROBE_ELEMS_ENV, 1 << 15))
 
 
 # -- online baseline ----------------------------------------------------------

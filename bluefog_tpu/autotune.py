@@ -199,7 +199,9 @@ def autotune_interval() -> int:
     arithmetic only (advisory harvest + at most one bounded candidate
     search); the default keeps the amortized cost well under the 1 %
     acceptance bound re-measured by ``BENCH_MODE=autotune``."""
-    return max(1, int(os.environ.get(INTERVAL_ENV, "50")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(INTERVAL_ENV, 50))
 
 
 def dry_run_enabled() -> bool:
@@ -219,12 +221,10 @@ def cooldown_samples() -> int:
     configure swap-per-re-fire topology thrash. Tests and benches that
     need a faster clock pass ``cooldown=`` to the constructor, which
     is deliberately not floored."""
-    try:
-        return max(COOLDOWN_SAMPLES, int(os.environ.get(
-            COOLDOWN_ENV, str(COOLDOWN_SAMPLES)
-        )))
-    except ValueError:
-        return COOLDOWN_SAMPLES
+    from bluefog_tpu.logging_util import env_int
+
+    return max(COOLDOWN_SAMPLES,
+               env_int(COOLDOWN_ENV, COOLDOWN_SAMPLES))
 
 
 def wire_tiers() -> Tuple[str, ...]:
@@ -429,6 +429,11 @@ class DecisionRecord:
     # distinguish choices scored for a synchronous combine from ones
     # made while the async push-sum lane owned the wire
     async_mode: bool = False
+    # whether the memory observatory had an un-cooled-down
+    # memory_pressure advisory on record when the decision was taken:
+    # a topology choice made on a chip near OOM reads differently in a
+    # postmortem than one made with headroom to spare
+    memory_pressure: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -447,6 +452,7 @@ class DecisionRecord:
             "topo_version_after": self.topo_version_after,
             "dry_run": self.dry_run,
             "async_mode": self.async_mode,
+            "memory_pressure": self.memory_pressure,
         }
 
 
@@ -457,6 +463,22 @@ def _async_mode() -> bool:
         from bluefog_tpu import async_gossip
 
         return async_gossip.active() is not None
+    except Exception:
+        return False
+
+
+def _memory_pressure() -> bool:
+    """True when the memory observatory has an un-cooled-down
+    ``memory_pressure`` advisory — i.e. one inside its re-fire window
+    right now, not merely somewhere in history (decision records
+    carry it — the audit trail must show which choices were made on a
+    chip near OOM, and a pressure episode resolved hours ago must not
+    taint every later record)."""
+    try:
+        from bluefog_tpu import memory as mem_mod
+
+        obs = mem_mod.active()
+        return obs is not None and obs.pressure_active()
     except Exception:
         return False
 
@@ -1069,6 +1091,7 @@ class TopologyAutotuner:
             topo_version_after=int(ctx.topo_version),
             dry_run=self.dry_run,
             async_mode=_async_mode(),
+            memory_pressure=_memory_pressure(),
         )
         self._emit(record)
         return record
@@ -1187,6 +1210,7 @@ class TopologyAutotuner:
                 topo_version_after=int(ctx.topo_version),
                 dry_run=self.dry_run,
                 async_mode=_async_mode(),
+                memory_pressure=_memory_pressure(),
             )
             self._emit_verification(verdict)
             self._emit(record)

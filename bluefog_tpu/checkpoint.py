@@ -212,7 +212,19 @@ def save(path: str, step: int, params, opt_state, optimizer=None) -> str:
     then current, which is also how a real fleet recovers a shard whose
     owner died (docs/sharding.md). A small ``<step>.graph.json`` sidecar
     carries the graph-info block so restore can refuse a mismatched
-    world/live set BEFORE allocating any state buffers."""
+    world/live set BEFORE allocating any state buffers.
+
+    The whole save runs under the memory observatory's
+    ``checkpoint_save`` phase watermark (the gather-on-save path
+    briefly materializes the full per-coordinate state — the exact
+    transient an OOM postmortem needs attributed)."""
+    from bluefog_tpu import memory as memory_mod
+
+    with memory_mod.phase_scope("checkpoint_save"):
+        return _save_inner(path, step, params, opt_state, optimizer)
+
+
+def _save_inner(path, step, params, opt_state, optimizer):
     target = os.path.join(os.path.abspath(path), str(int(step)))
     payload = {
         "step": int(step),

@@ -68,7 +68,9 @@ def bucket_bytes_cap() -> int:
     """
     if os.environ.get("BLUEFOG_OVERLAP", "1").lower() in ("0", "false", "off"):
         return 0
-    return int(os.environ.get("BLUEFOG_BUCKET_BYTES", str(4 << 20)))
+    from bluefog_tpu.logging_util import env_int
+
+    return env_int("BLUEFOG_BUCKET_BYTES", 4 << 20)
 
 
 def bucket_bounds(

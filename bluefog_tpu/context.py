@@ -393,6 +393,15 @@ def init(
     from bluefog_tpu import staleness as _staleness
 
     _staleness.on_init(_context)
+    # Memory observatory (BLUEFOG_MEMORY=1) + OOM crash hooks: fresh
+    # session per mesh — a torn-down mesh's census and watermark must
+    # not read as the new mesh's footprint. Installed AFTER the flight
+    # recorder so its excepthook runs FIRST on an uncaught error (the
+    # ranked census must land in the side table before the crash dump
+    # is written).
+    from bluefog_tpu import memory as _memory
+
+    _memory.on_init(_context)
     # Autotune controller (BLUEFOG_AUTOTUNE=1): fresh session per mesh
     # — stale hysteresis state or a rollback target captured against a
     # torn-down mesh must never actuate on the new one.
@@ -438,6 +447,9 @@ def shutdown() -> None:
     _attribution.on_shutdown()
     _health.on_shutdown()
     _staleness.on_shutdown()
+    from bluefog_tpu import memory as _memory
+
+    _memory.on_shutdown()
     # the shard registry is per-session observability state: a stale
     # layout summary must not survive into the next init's /fleet
     from bluefog_tpu import sharding as _sharding

@@ -17,6 +17,7 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
     degrade:rank=1,step=4,factor=0.25
     slow:rank=5,step=0,factor=10
     slow:rank=5,step=20,factor=4,steps=50
+    oom:rank=3,step=12
 
 - ``kill``     — the rank is dead from ``step`` on (process crash).
 - ``stall``    — the rank blocks for ``seconds`` at ``step``. A stall at
@@ -53,6 +54,18 @@ Plan grammar (``BLUEFOG_FAULT_PLAN``), semicolon-separated clauses::
   This is the 10x-straggler chaos primitive the ``BENCH_MODE=async``
   evidence drives: rank-scoped by definition (``peer=`` is rejected —
   a slow *chip* has no single slow edge).
+- ``oom``      — simulated device allocation failure: at ``step`` the
+  rank's dispatch raises
+  :class:`bluefog_tpu.memory.SimulatedResourceExhausted` (a
+  ``MemoryError`` whose message carries the XLA
+  ``RESOURCE_EXHAUSTED`` casing) AFTER running the memory
+  observatory's OOM forensics path — ranked buffer census into the
+  flight side table, flight dump — so an OOM postmortem
+  (``tools/memory_report.py``) is a reproducible tier-1 unit test.
+  Rank-scoped like ``slow`` (``peer=``/``seconds=``/``factor=`` are
+  rejected); the fault fires once, it is not a verdict and never
+  triggers repair (the process is presumed to die — whether the
+  *run* survives is the supervisor's restart policy).
 
 Programmatic equivalent: :func:`bluefog_tpu.elastic.inject`.
 """
@@ -65,7 +78,7 @@ __all__ = ["Fault", "FaultPlan", "parse_fault_plan", "FAULT_PLAN_ENV"]
 
 FAULT_PLAN_ENV = "BLUEFOG_FAULT_PLAN"
 
-_KINDS = ("kill", "stall", "degrade", "slow")
+_KINDS = ("kill", "stall", "degrade", "slow", "oom")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +128,13 @@ class Fault:
             raise ValueError(
                 "seconds= does not apply to slow faults (the dilation "
                 "is a per-step factor; bound it with steps=)"
+            )
+        if self.kind == "oom" and (
+            self.seconds or self.factor != 1.0
+        ):
+            raise ValueError(
+                "seconds=/factor= do not apply to oom faults (an "
+                "allocation failure is instantaneous and total)"
             )
         if self.peer >= 0 and self.kind not in ("degrade", "stall"):
             raise ValueError(

@@ -372,6 +372,35 @@ class ElasticSession:
                 f"elastic:slow rank={fault.rank} "
                 f"factor={fault.factor:g}", "FAULT"
             )
+        elif fault.kind == "oom":
+            # simulated allocation failure: run the memory
+            # observatory's full forensics path (ranked census ->
+            # flight side table -> dump), then raise exactly what a
+            # real RESOURCE_EXHAUSTED would — the dispatch this step
+            # was about to run never happens, the caller sees the OOM.
+            # Deliberately NOT a verdict or repair trigger: the chaos
+            # primitive tests the postmortem, not recovery (the fault
+            # is consumed by the _applied set, so a supervisor retry
+            # proceeds past it).
+            from bluefog_tpu import memory as memory_mod
+
+            metrics_mod.counter("bluefog.elastic.oom_faults").inc()
+            tl.timeline_record_instant(
+                f"elastic:oom rank={fault.rank}", "FAULT"
+            )
+            memory_mod.on_oom(
+                f"chaos:rank={fault.rank}",
+                "RESOURCE_EXHAUSTED: injected allocation failure",
+            )
+            exc = memory_mod.SimulatedResourceExhausted(
+                f"rank={fault.rank} step={step}"
+            )
+            # forensics already ran above: mark the instance so the
+            # memory excepthook does not run them AGAIN if the raise
+            # goes uncaught (one injected failure must count once,
+            # like a real single-hook OOM)
+            exc._bf_oom_forensics_done = True
+            raise exc
 
     # -- detection + repair --------------------------------------------------
 

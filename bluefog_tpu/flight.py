@@ -59,6 +59,14 @@ What gets recorded (event ``kind`` -> payload):
   staleness observatory's lineage lane (surface, mean/max age, lane
   self-check), so a postmortem can see whether data was going stale
   in the steps before a hang.
+- ``memory`` — per-sample live-buffer totals and headroom from the
+  memory observatory (:mod:`bluefog_tpu.memory`), so a postmortem can
+  see the footprint trending toward the budget in the steps before an
+  OOM.
+- ``oom`` — a device allocation failure (real ``RESOURCE_EXHAUSTED``
+  caught by the memory observatory's crash hooks, or the injected
+  ``oom`` chaos fault); the ranked buffer census rides the advisory
+  side table so it survives ring eviction.
 - ``crash`` / ``sigterm`` — the run's last words.
 
 Dump triggers: a watchdog stall, an elastic SUSPECT/DEAD verdict, an
@@ -190,7 +198,9 @@ def enabled() -> bool:
 
 
 def capacity() -> int:
-    return max(256, int(os.environ.get(CAPACITY_ENV, "8192")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(256, env_int(CAPACITY_ENV, 8192))
 
 
 def dump_dir() -> Optional[str]:

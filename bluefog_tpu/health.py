@@ -143,6 +143,12 @@ FLEET_FIELDS = (
     #                        (bluefog_tpu.staleness; 0 when the
     #                        observatory is off) — fleet-wide
     #                        min/mean/max age rides the same lane
+    "mem_bytes_per_rank",  # measured per-chip memory footprint
+    #                        (bluefog_tpu.memory census; 0 when the
+    #                        observatory is off)
+    "mem_headroom",        # budget minus footprint (0 when no
+    #                        BLUEFOG_MEMORY_BUDGET is configured) —
+    #                        the fleet min is the chip closest to OOM
 )
 
 
@@ -168,24 +174,24 @@ def health_interval() -> int:
     samples). A sample is host arithmetic plus one tiny push-sum lane
     dispatch; the default keeps the amortized cost under the 1 %
     acceptance bound re-measured by ``BENCH_MODE=health``."""
-    return max(1, int(os.environ.get(INTERVAL_ENV, "20")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(INTERVAL_ENV, 20))
 
 
 def health_port() -> int:
     """``BLUEFOG_HEALTH_PORT`` (0/unset = no serving)."""
-    try:
-        return int(os.environ.get(PORT_ENV, "0"))
-    except ValueError:
-        return 0
+    from bluefog_tpu.logging_util import env_int
+
+    return env_int(PORT_ENV, 0)
 
 
 def health_eps() -> float:
     """Consensus target for the time-to-ε projection
     (``BLUEFOG_HEALTH_EPS``, default 1e-6)."""
-    try:
-        return float(os.environ.get(EPS_ENV, "1e-6"))
-    except ValueError:
-        return 1e-6
+    from bluefog_tpu.logging_util import env_float
+
+    return env_float(EPS_ENV, 1e-6)
 
 
 # -- measured-decay estimation ------------------------------------------------
@@ -716,7 +722,27 @@ class HealthPlane:
         )
         vec[:, 4] = digest
         vec[:, 5] = self._staleness_age_max()
+        mem_bytes, mem_headroom = self._memory_fields()
+        vec[:, 6] = mem_bytes
+        vec[:, 7] = mem_headroom
         return vec
+
+    @staticmethod
+    def _memory_fields() -> Tuple[float, float]:
+        """This controller's measured per-chip footprint and headroom
+        ((0.0, 0.0) when the memory observatory is off) — aggregated
+        fleet-wide min/mean/max over the push-sum lane: the fleet MIN
+        headroom is the chip closest to OOM."""
+        try:
+            from bluefog_tpu import memory as mem_mod
+
+            obs = mem_mod.active()
+            if obs is None:
+                return 0.0, 0.0
+            return (float(obs.last_bytes_per_rank()),
+                    float(obs.last_headroom()))
+        except Exception:
+            return 0.0, 0.0
 
     @staticmethod
     def _staleness_age_max() -> float:
@@ -1136,6 +1162,29 @@ class HealthPlane:
             shard = sharding_mod.summary()
             if shard is not None:
                 rep["shard"] = shard
+        except Exception:
+            pass
+        # the memory observatory's summary rides the same surface: an
+        # operator sizing a fleet reads per-chip footprint, headroom
+        # against the budget, and the last ranked census next to the
+        # health numbers (BLUEFOG_MEMORY, docs/memory.md)
+        try:
+            from bluefog_tpu import memory as mem_mod
+
+            obs = mem_mod.active()
+            if obs is not None:
+                rep["memory"] = {
+                    "bytes_per_rank": int(obs.last_bytes_per_rank()),
+                    "headroom_bytes": (
+                        int(obs.last_headroom()) if obs.budget else None
+                    ),
+                    "budget_bytes": obs.budget or None,
+                    "peak_bytes_per_rank": int(obs._peak_bytes),
+                    "oom_events": obs.oom_events,
+                    "ranked_census": mem_mod.ranked_census(
+                        obs.last_census
+                    )[:4],
+                }
         except Exception:
             pass
         return rep

@@ -17,7 +17,7 @@ import os
 
 __all__ = [
     "logger", "set_log_level", "warn_once", "json_safe",
-    "append_jsonl", "TRACE",
+    "append_jsonl", "env_int", "env_float", "TRACE",
 ]
 
 TRACE = 5  # below logging.DEBUG, parity with the reference's trace level
@@ -67,6 +67,46 @@ def warn_once(key: str, msg: str, *args) -> None:
         return
     _warned_once.add(key)
     logger.warning(msg, *args)
+
+
+def env_int(name: str, default: int) -> int:
+    """``int(os.environ[name])`` with the BLUEFOG_LOG_LEVEL fallback
+    discipline: a malformed value warns exactly once and falls back to
+    ``default`` instead of raising ``ValueError`` deep inside a
+    dispatch path. The single parser behind every integer
+    ``BLUEFOG_*`` knob (intervals, capacities, byte budgets) — a
+    typo'd knob must degrade loudly to the documented default, never
+    crash the step that happened to read it first."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return int(default)
+    try:
+        return int(raw)
+    except ValueError:
+        warn_once(
+            f"env_int:{name}:{raw}",
+            "ignoring malformed %s=%r (not an integer); using the "
+            "default %s", name, raw, default,
+        )
+        return int(default)
+
+
+def env_float(name: str, default: float) -> float:
+    """:func:`env_int` for float-valued knobs (timeouts, epsilons,
+    tolerance fractions): malformed values warn once and fall back to
+    the default instead of raising."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        warn_once(
+            f"env_float:{name}:{raw}",
+            "ignoring malformed %s=%r (not a number); using the "
+            "default %s", name, raw, default,
+        )
+        return float(default)
 
 
 def json_safe(obj):

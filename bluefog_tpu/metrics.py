@@ -297,7 +297,9 @@ def metrics_interval() -> int:
     steps run the metrics-off program unchanged) and its buffer is
     drained with an async device->host copy. Larger interval = coarser
     health sampling, proportionally lower overhead."""
-    return max(1, int(os.environ.get("BLUEFOG_METRICS_INTERVAL", "10")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int("BLUEFOG_METRICS_INTERVAL", 10))
 
 
 # -- device tier: buffer layout and traced helpers ----------------------------
@@ -333,10 +335,9 @@ def sample_elems_cap() -> int:
     packing order). Health telemetry needs drift *trends*, not the
     tenth significant digit; set the knob huge to force exact
     coverage."""
-    return max(
-        512, int(os.environ.get("BLUEFOG_METRICS_SAMPLE_ELEMS",
-                                str(1 << 16)))
-    )
+    from bluefog_tpu.logging_util import env_int
+
+    return max(512, env_int("BLUEFOG_METRICS_SAMPLE_ELEMS", 1 << 16))
 
 
 # Subsample granularity: whole contiguous 512-element chunks, matching

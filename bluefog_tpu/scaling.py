@@ -60,6 +60,7 @@ __all__ = [
     "plan_comm_summary",
     "wire_payload_bytes",
     "wire_bytes_per_step",
+    "quantized_temporaries_bytes",
     "optimizer_state_bytes",
     "LINEAGE_TAG_BYTES",
     "ring_allreduce_cost",
@@ -145,6 +146,43 @@ def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
         for itemsize, n in n_elems_by_itemsize.items()
     ) + (LINEAGE_TAG_BYTES if lineage else 0)
     return per_round * n_rounds
+
+def quantized_temporaries_bytes(n_elems: int,
+                                wire: Optional[str] = None) -> int:
+    """Analytic bytes of the full-width temporaries the COMPOSITE
+    quantized wire path materializes per round today — the
+    quantize → pack → ppermute → unpack → dequant chain runs as
+    separate XLA ops, so beyond the wire payload itself it stages (a)
+    the int8 quantize output before packing (plus the packed nibble
+    copy for the int4 tiers) and (b) the dequantized **full-width f32
+    reconstruction** of every received payload. That f32 temporary is
+    exactly what a fused Pallas kernel (EQuARX, arxiv 2506.17615)
+    would never materialize, which makes this function the committed
+    *before*-baseline the ROADMAP kernel-fusion item must beat
+    (``BENCH_MODE=memory`` pairs it with the measured XLA
+    ``temp_size_in_bytes`` of the compiled combine).
+
+    Block-scaled tiers stage whole 512-element blocks (the payload is
+    padded to the scale grid before the ppermute). fp32 ships verbatim
+    — no conversion temporaries — and returns 0.
+    """
+    from bluefog_tpu.collective.inner import _QUANT_CHUNK
+
+    if not n_elems:
+        return 0
+    if wire in ("int8", "int8_ef", "int4", "int4_ef"):
+        blocks = -(-int(n_elems) // _QUANT_CHUNK)
+        padded = blocks * _QUANT_CHUNK
+        full_width = 4 * padded      # f32 dequant of the received payload
+        staging = padded             # int8 quantize output pre-send
+        if wire in ("int4", "int4_ef"):
+            staging += padded // 2   # the packed-nibble copy
+        return full_width + staging
+    if wire == "bf16":
+        # the f32 reconstruction of the received bf16 payload
+        return 4 * int(n_elems)
+    return 0
+
 
 def _leaf_bytes(leaf) -> int:
     """Bytes of one array-like leaf (works on jax/numpy arrays and

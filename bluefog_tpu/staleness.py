@@ -141,7 +141,9 @@ def staleness_interval() -> int:
     int32 lane dispatch plus O(edges) host folding; the default keeps
     the amortized cost under the 1 % acceptance bound re-measured by
     ``BENCH_MODE=staleness``."""
-    return max(1, int(os.environ.get(INTERVAL_ENV, "20")))
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(INTERVAL_ENV, 20))
 
 
 def staleness_bound() -> int:
@@ -150,10 +152,9 @@ def staleness_bound() -> int:
     combine delivers age 0 and ``delayed=True`` age 1, so the default
     flags only genuinely anomalous delivery — and doubles as the gate
     a bounded-staleness asynchronous mode would enforce."""
-    try:
-        return max(1, int(os.environ.get(BOUND_ENV, "4")))
-    except ValueError:
-        return 4
+    from bluefog_tpu.logging_util import env_int
+
+    return max(1, env_int(BOUND_ENV, 4))
 
 
 def age_adjusted_rate(rate: Optional[float], age: Optional[float],

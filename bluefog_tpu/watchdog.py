@@ -83,7 +83,9 @@ def is_suspended() -> bool:
 def stall_timeout() -> float:
     global _timeout
     if _timeout is None:
-        _timeout = float(os.environ.get("BLUEFOG_STALL_TIMEOUT", "60"))
+        from bluefog_tpu.logging_util import env_float
+
+        _timeout = env_float("BLUEFOG_STALL_TIMEOUT", 60.0)
     return _timeout
 
 

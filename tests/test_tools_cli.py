@@ -24,36 +24,56 @@ TOOLS = sorted(
 
 # Hard kill bound for the subprocess itself...
 HELP_TIMEOUT_S = 60.0
-# ...and the bound that actually pins the lazy-import discipline: an
-# argparse-before-jax --help is interpreter startup + argparse
-# (~0.15 s measured); a tool that re-grows a module-level `import jax`
-# (+ flax/optax + backend init) lands well past this even on a slow
-# CI host. Deliberately tighter than the subprocess timeout so a slow
-# (but not hung) regression FAILS instead of timing out vacuously.
-HELP_WALL_BOUND_S = 10.0
+
+
+def _help_wall_bound_s() -> float:
+    """The bound that actually pins the --help-before-jax-import rule,
+    for EVERY tool: an argparse-before-jax --help is interpreter
+    startup + argparse (~0.12 s measured), so the rule is SUB-SECOND.
+    A tool that re-grows a module-level ``import jax`` (+ flax/optax +
+    backend init) lands at several seconds even on a fast host. The
+    bound scales off a measured bare-interpreter baseline so an
+    overloaded CI host degrades the bound, never fakes a regression —
+    but on any healthy host it stays at the 1-second rule."""
+    t0 = time.perf_counter()
+    subprocess.run([sys.executable, "-c", "pass"], capture_output=True)
+    baseline = time.perf_counter() - t0
+    return max(1.0, 8.0 * baseline)
+
+
+HELP_WALL_BOUND_S = _help_wall_bound_s()
 
 
 @pytest.mark.parametrize(
     "tool", TOOLS, ids=[os.path.basename(t) for t in TOOLS]
 )
 def test_tool_help_exits_zero(tool):
-    t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, tool, "--help"],
-        capture_output=True, text=True, timeout=HELP_TIMEOUT_S,
-        cwd=REPO,
-    )
-    elapsed = time.perf_counter() - t0
-    assert proc.returncode == 0, (
-        f"{os.path.basename(tool)} --help exited "
-        f"{proc.returncode}: {proc.stderr[-400:]}"
-    )
-    assert proc.stdout.strip(), (
-        f"{os.path.basename(tool)} --help printed nothing"
-    )
+    # best of two runs: one transient CI load spike during a single
+    # subprocess must not read as a lazy-import regression, while a
+    # genuine module-level `import jax` (seconds, every run) still
+    # fails both attempts
+    elapsed = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, tool, "--help"],
+            capture_output=True, text=True, timeout=HELP_TIMEOUT_S,
+            cwd=REPO,
+        )
+        elapsed = min(elapsed, time.perf_counter() - t0)
+        assert proc.returncode == 0, (
+            f"{os.path.basename(tool)} --help exited "
+            f"{proc.returncode}: {proc.stderr[-400:]}"
+        )
+        assert proc.stdout.strip(), (
+            f"{os.path.basename(tool)} --help printed nothing"
+        )
+        if elapsed < HELP_WALL_BOUND_S:
+            break
     assert elapsed < HELP_WALL_BOUND_S, (
-        f"{os.path.basename(tool)} --help took {elapsed:.1f}s — a "
-        "CLI gate probably slipped below a heavy import"
+        f"{os.path.basename(tool)} --help took {elapsed:.2f}s (best "
+        f"of 2) against the {HELP_WALL_BOUND_S:.1f}s sub-second-rule "
+        "bound — a CLI gate probably slipped below a heavy import"
     )
 
 
@@ -63,8 +83,8 @@ def test_tools_enumerated():
     names = {os.path.basename(t) for t in TOOLS}
     assert {
         "autotune_report.py", "bench_diff.py", "doctor.py",
-        "fleet_report.py", "metrics_report.py", "shard_plan.py",
-        "staleness_report.py", "trace_merge.py", "hlo_overlap_scan.py",
-        "hlo_dump.py", "perf_probe.py", "resnet_layer_profile.py",
-        "transformer_stage_profile.py",
+        "fleet_report.py", "memory_report.py", "metrics_report.py",
+        "shard_plan.py", "staleness_report.py", "trace_merge.py",
+        "hlo_overlap_scan.py", "hlo_dump.py", "perf_probe.py",
+        "resnet_layer_profile.py", "transformer_stage_profile.py",
     } <= names

@@ -59,6 +59,10 @@ CONFIG_INT_KEYS = {
 HARNESS_KEYS = {
     "windows", "degenerate", "degenerate_cells", "unit",
     "harness_validation", "rejected", "anchor_tflops",
+    # host-memory context on the provenance line (and any row that
+    # replicates it): describes the measuring process, not the thing
+    # measured — never a comparability break
+    "peak_rss_bytes",
 }
 
 # Derived normalization fields that arrived WITH the anchor feature:
@@ -127,10 +131,22 @@ SHARD_DERIVED = {
     "traj_max_dev",
 }
 
+# Memory-observatory columns that arrived with the memory evidence
+# family (BENCH_MODE=memory): buffer-census byte accounting, analytic
+# reconciliation residuals and XLA temp-size readings are memory
+# bookkeeping derived from the program/config, not timed measurements,
+# so their one-sided appearance against a pre-memory artifact is the
+# tooling gaining a column — never a timing-harness change.
+MEMORY_DERIVED = {
+    "live_bytes_per_rank", "measured_state_bytes",
+    "analytic_state_bytes", "reconcile_rel_err", "temp_bytes_measured",
+    "temp_bytes_analytic", "full_width_bytes", "headroom_bytes",
+}
+
 # Every one-sided-tolerated derived column set.
 TOOLING_DERIVED = (
     ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
-    | ASYNC_DERIVED | SHARD_DERIVED
+    | ASYNC_DERIVED | SHARD_DERIVED | MEMORY_DERIVED
 )
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")

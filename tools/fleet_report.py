@@ -118,6 +118,10 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
         # the controller off — simply lacks the block, and the row
         # degrades to autotune=absent rather than faking zeros
         at = d.get("autotune")
+        # memory-observatory columns (bluefog_tpu.memory): same
+        # absent-block degradation — a pre-memory artifact renders
+        # memory=absent, never fabricated zero footprints
+        mem = d.get("memory")
         rows.append({
             "source": src,
             "status": hz.get("status", "?"),
@@ -146,6 +150,17 @@ def build_report(dumps: List[dict], sources: List[str]) -> dict:
                 at.get("rollbacks") if at else None
             ),
             "autotune": "active" if at else "absent",
+            "mem_bytes_per_rank": (
+                mem.get("bytes_per_rank") if mem else None
+            ),
+            "mem_headroom_bytes": (
+                mem.get("headroom_bytes") if mem else None
+            ),
+            "mem_peak_bytes": (
+                mem.get("peak_bytes_per_rank") if mem else None
+            ),
+            "oom_events": mem.get("oom_events") if mem else None,
+            "memory": "active" if mem else "absent",
         })
         # any rank's in-band view serves as the fleet block (they agree
         # to within the disclosed push-sum residual); keep the one with
@@ -233,7 +248,8 @@ def main(argv=None) -> int:
     cols = ("source", "status", "step_ms_ewma", "consensus",
             "mixing_efficiency", "advisories", "dominant_advisory",
             "autotune_last_action", "autotune_decisions",
-            "autotune_rollbacks")
+            "autotune_rollbacks", "mem_bytes_per_rank",
+            "mem_headroom_bytes", "oom_events")
     for r in report["processes"]:
         if r.get("unreadable"):
             err = f" ({r['error']})" if r.get("error") else ""

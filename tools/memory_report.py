@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Triage a memory-observatory artifact — and reconstruct an OOM
+postmortem — from committed files alone.
+
+The memory observatory (:mod:`bluefog_tpu.memory`, docs/memory.md)
+leaves up to three artifacts per controller process:
+``bf.memory.dump(path)`` JSON, the ``BLUEFOG_MEMORY_FILE`` JSONL
+stream, and — after an OOM (real or the injected ``oom`` chaos fault)
+— a flight dump whose advisory side table carries the ranked buffer
+census. This tool joins them into: the footprint trend (census total,
+per-category bytes, headroom against the budget), the phase watermark
+table, the ``memory_drift`` / ``memory_pressure`` advisory history,
+and — when an ``oom`` record is present — the postmortem sentence
+naming the owner category that was biggest when the chip ran out.
+
+Usage::
+
+    python tools/memory_report.py memory_dump.json
+    python tools/memory_report.py --jsonl memory.jsonl
+    python tools/memory_report.py --flight flight_0.json
+    python tools/memory_report.py ... --json
+
+No jax import, no live mesh needed. Exit status 0 on a parseable input
+set, 2 when nothing could be read.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("kind") != "memory_dump":
+        raise ValueError(
+            f"{path} is not a memory artifact (expected kind="
+            f"'memory_dump', got {d.get('kind')!r})"
+        )
+    return d
+
+
+def load_jsonl(path: str) -> dict:
+    """Rebuild a dump-shaped dict from the BLUEFOG_MEMORY_FILE stream
+    (samples + advisories, one JSON object per line)."""
+    samples: List[dict] = []
+    advisories: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("kind") == "sample":
+                samples.append(obj)
+            elif obj.get("kind") == "advisory":
+                advisories.append(obj)
+    last = samples[-1] if samples else {}
+    return {
+        "kind": "memory_dump",
+        "samples": samples,
+        "advisories": advisories,
+        "comm_steps": max(
+            (s.get("comm_steps", 0) for s in samples), default=0
+        ),
+        "peak_bytes_per_rank": max(
+            (s.get("peak_bytes_per_rank", 0) for s in samples),
+            default=0,
+        ),
+        "last_census_ranked": _rank(last.get("census") or {}),
+        "oom_events": sum(
+            1 for a in advisories
+            if (a.get("advisory_kind") or a.get("kind")) == "oom"
+        ),
+    }
+
+
+def load_flight(path: str) -> Optional[dict]:
+    """Extract the OOM forensics from a flight dump's advisory side
+    table (:mod:`bluefog_tpu.flight`): the ranked census rides there
+    precisely so the postmortem survives ring eviction. Returns a
+    postmortem dict, or None when the dump carries no oom record."""
+    with open(path) as f:
+        d = json.load(f)
+    ooms = [
+        a for a in (d.get("advisories") or [])
+        if a.get("kind") == "oom"
+    ]
+    if not ooms:
+        return None
+    last = ooms[-1]
+    return {
+        "source": path,
+        "dump_reason": d.get("reason"),
+        "dump_history": d.get("dump_history"),
+        "reason": last.get("reason"),
+        "message": last.get("message"),
+        "ranked_census": last.get("ranked_census") or [],
+        "top_category": last.get("top_category"),
+        "bytes_per_rank": last.get("bytes_per_rank"),
+        "budget_bytes": last.get("budget_bytes"),
+    }
+
+
+def _rank(census: dict) -> List[dict]:
+    rows = [
+        {"category": c, "bytes": rec.get("bytes", 0),
+         "arrays": rec.get("arrays", 0)}
+        for c, rec in census.items()
+    ]
+    rows.sort(key=lambda r: (-r["bytes"], r["category"]))
+    return rows
+
+
+def build_report(dump: dict, postmortems: List[dict]) -> dict:
+    samples = dump.get("samples") or []
+    advisories = dump.get("advisories") or []
+    by_kind: dict = {}
+    for a in advisories:
+        k = a.get("advisory_kind") or a.get("kind") or "?"
+        by_kind.setdefault(k, []).append(a)
+    trend = [
+        {
+            "step": s.get("step"),
+            "live_bytes_total": s.get("live_bytes_total"),
+            "headroom_bytes": s.get("headroom_bytes"),
+            "reconcile_rel_err": s.get("reconcile_rel_err"),
+        }
+        for s in samples
+    ]
+    last = samples[-1] if samples else {}
+    return {
+        "kind": "memory_report",
+        "comm_steps": dump.get("comm_steps"),
+        "interval": dump.get("interval"),
+        "budget_bytes": dump.get("budget_bytes"),
+        "peak_bytes_per_rank": dump.get("peak_bytes_per_rank"),
+        "samples": len(samples),
+        "trend_tail": trend[-8:],
+        "last_census": (
+            dump.get("last_census_ranked")
+            or _rank(last.get("census") or {})
+        ),
+        "phase_peaks": dump.get("phase_peaks") or {},
+        "advisory_counts": {
+            k: len(v) for k, v in sorted(by_kind.items())
+        },
+        "drift": [
+            {
+                "step": a.get("step"),
+                "measured": a.get("measured_state_bytes"),
+                "analytic": a.get("analytic_state_bytes"),
+                "rel_err": a.get("rel_err"),
+            }
+            for a in by_kind.get("memory_drift", [])[:4]
+        ],
+        "pressure": [
+            {
+                "step": a.get("step"),
+                "headroom_bytes": a.get("headroom_bytes"),
+                "shard_hint": a.get("shard_hint"),
+            }
+            for a in by_kind.get("memory_pressure", [])[:4]
+        ],
+        "oom_events": dump.get("oom_events", 0),
+        "postmortems": postmortems,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="memory artifact JSON files "
+                         "(bf.memory.dump output)")
+    ap.add_argument("--jsonl",
+                    help="BLUEFOG_MEMORY_FILE stream to rebuild a "
+                         "report from")
+    ap.add_argument("--flight", action="append", default=[],
+                    help="flight dump(s) to extract an OOM postmortem "
+                         "from (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    dumps: List[dict] = []
+    for p in args.artifacts:
+        try:
+            dumps.append(load_artifact(p))
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if args.jsonl:
+        try:
+            dumps.append(load_jsonl(args.jsonl))
+        except OSError as e:
+            print(f"warning: {e}", file=sys.stderr)
+    postmortems: List[dict] = []
+    for p in args.flight:
+        try:
+            pm = load_flight(p)
+            if pm is not None:
+                postmortems.append(pm)
+            else:
+                print(f"warning: {p} carries no oom record",
+                      file=sys.stderr)
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if not dumps and not postmortems:
+        print("no readable memory artifacts given", file=sys.stderr)
+        return 2
+
+    merged: Optional[dict] = None
+    for d in dumps:
+        if merged is None:
+            merged = dict(d)
+            merged["samples"] = list(d.get("samples") or [])
+            merged["advisories"] = list(d.get("advisories") or [])
+            continue
+        merged["samples"] += d.get("samples") or []
+        merged["advisories"] += d.get("advisories") or []
+        merged["peak_bytes_per_rank"] = max(
+            merged.get("peak_bytes_per_rank") or 0,
+            d.get("peak_bytes_per_rank") or 0,
+        )
+        merged["oom_events"] = (
+            (merged.get("oom_events") or 0)
+            + (d.get("oom_events") or 0)
+        )
+    report = build_report(merged or {}, postmortems)
+
+    if args.json:
+        print(json.dumps(report))
+        return 0
+
+    print(f"memory: {report['samples']} sample(s) over "
+          f"{report['comm_steps']} comm steps, peak "
+          f"{report['peak_bytes_per_rank']} B/rank, "
+          f"budget {report['budget_bytes']}, "
+          f"{report['oom_events']} oom event(s)")
+    if report["last_census"]:
+        print("last census (largest owner first):")
+        for row in report["last_census"][:8]:
+            print(f"  {row['category']:<10} {row['bytes']:>14,} B  "
+                  f"({row['arrays']} arrays)")
+    for name, rec in sorted(report["phase_peaks"].items()):
+        print(f"phase {name:<16} peak_rss {rec.get('peak_rss_bytes', 0):>16,.0f} B"
+              f"  over {rec.get('count')} scope(s)")
+    for k, n in report["advisory_counts"].items():
+        print(f"advisory {k}: {n}")
+    for d in report["drift"]:
+        print(f"  drift @step {d['step']}: measured {d['measured']} vs "
+              f"analytic {d['analytic']} (rel_err {d['rel_err']})")
+    for p in report["pressure"]:
+        hint = " — consider BLUEFOG_SHARD=1" if p.get("shard_hint") \
+            else ""
+        print(f"  pressure @step {p['step']}: headroom "
+              f"{p['headroom_bytes']} B{hint}")
+    for pm in report["postmortems"]:
+        top = pm.get("top_category")
+        sentence = (
+            f"OOM postmortem ({pm.get('reason')}): the biggest owner "
+            f"when the chip ran out was '{top}'"
+        )
+        ranked = pm.get("ranked_census") or []
+        if ranked:
+            sentence += (
+                f" at {ranked[0].get('bytes'):,} B"
+            )
+        if pm.get("budget_bytes"):
+            sentence += f"; budget was {pm['budget_bytes']:,} B"
+        print(sentence)
+        for row in ranked[:6]:
+            print(f"    {row.get('category'):<10} "
+                  f"{row.get('bytes'):>14,} B  "
+                  f"({row.get('arrays')} arrays)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)
