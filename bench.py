@@ -4144,6 +4144,11 @@ def run_quant() -> int:
     and ``int4_ef`` reaches consensus quality no worse than int8's
     (within the disclosed multi-seed A/A spread — error feedback erases
     the coarser quantizer's floor, so it typically lands ORDERS below).
+    ``quant_kernel`` rows compare the fused wire kernels
+    (``BLUEFOG_WIRE_KERNELS``) against the composite path — measured
+    XLA scratch, step time, bitwise output equality — and gate the
+    fused scratch BELOW the fp32 row for int8 AND int4 (the full-width
+    temporary never materializes; docs/performance.md).
     A push-sum window run under ``BLUEFOG_WINDOW_WIRE=int4`` closes the
     artifact with the sender-mass-conservation check (drift bounded by
     f32 rounding, not quantization: the sender absorbs the residual of
@@ -4294,6 +4299,98 @@ def run_quant() -> int:
             "int4_ef_no_worse_than_int8": bool(equal_quality),
         }), flush=True)
 
+        # -- fused wire kernels: kernel-vs-composite ----------------------
+        # (BLUEFOG_WIRE_KERNELS, collective/kernels.py): same combine,
+        # compiled twice — composite (kernels pinned off, the
+        # MEMORY_EVIDENCE before-baseline) vs fused — comparing the
+        # measured XLA scratch, the step time, and bitwise equality of
+        # the outputs. The headline gate: the fused path's scratch
+        # lands BELOW the fp32 row (no full-width temporary exists),
+        # for int8 AND int4.
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from bluefog_tpu.collective import inner
+        from bluefog_tpu.collective import kernels as wire_kernels
+
+        k_plan = plan_from_topology(topo.RingGraph(n))
+        mesh = Mesh(np.array(jax.devices()[:n]), ("workers",))
+        xk = jax.device_put(
+            jnp.asarray(
+                np.random.RandomState(7)
+                .randn(n, dim).astype(np.float32) * 5.0
+            ),
+            NamedSharding(mesh, P("workers")),
+        )
+
+        def kernel_build(wire):
+            if wire is None:
+                body = lambda t: inner.neighbor_allreduce(
+                    t, k_plan, "workers"
+                )
+            else:
+                body = lambda t, w=wire: inner.weighted_combine_quantized(
+                    t, k_plan, "workers", wire=w
+                )
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=P("workers"),
+                out_specs=P("workers"),
+            ))
+            c = fn.lower(xk).compile()
+            return fn, int(c.memory_analysis().temp_size_in_bytes)
+
+        def kernel_time_us(fn, reps=30):
+            jax.block_until_ready(fn(xk))  # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jax.block_until_ready(fn(xk))
+            return 1e6 * (time.perf_counter() - t0) / reps
+
+        old_wk = os.environ.get("BLUEFOG_WIRE_KERNELS")
+        kernel_rows = []
+        try:
+            os.environ["BLUEFOG_WIRE_KERNELS"] = "0"
+            _, fp32_temp = kernel_build(None)
+            for wire in ("int8", "int4"):
+                os.environ["BLUEFOG_WIRE_KERNELS"] = "0"
+                fn_c, temp_c = kernel_build(wire)
+                out_c = np.asarray(fn_c(xk))
+                t_c = kernel_time_us(fn_c)
+                os.environ["BLUEFOG_WIRE_KERNELS"] = "1"
+                fn_f, temp_f = kernel_build(wire)
+                out_f = np.asarray(fn_f(xk))
+                t_f = kernel_time_us(fn_f)
+                kernel_rows.append({
+                    "metric": "quant_kernel",
+                    "wire": wire,
+                    "payload_elems": dim,
+                    "kernels_native": wire_kernels.pallas_available()
+                    and jax.default_backend() == "tpu",
+                    "temp_bytes_composite": temp_c,
+                    "temp_bytes_fused": temp_f,
+                    "temp_bytes_fp32": fp32_temp,
+                    "temp_bytes_analytic_fused": (
+                        scaling.quantized_temporaries_bytes(
+                            dim, wire, fused=True
+                        )
+                    ),
+                    "temp_bytes_analytic_composite": (
+                        scaling.quantized_temporaries_bytes(dim, wire)
+                    ),
+                    "fused_below_fp32_row": temp_f < fp32_temp,
+                    "step_time_composite_us": round(t_c, 2),
+                    "step_time_fused_us": round(t_f, 2),
+                    "bitwise_equal": bool(
+                        (out_c.view(np.uint32)
+                         == out_f.view(np.uint32)).all()
+                    ),
+                })
+                print(json.dumps(kernel_rows[-1]), flush=True)
+        finally:
+            if old_wk is None:
+                os.environ.pop("BLUEFOG_WIRE_KERNELS", None)
+            else:
+                os.environ["BLUEFOG_WIRE_KERNELS"] = old_wk
+
         # push-sum mass conservation under the quantized window wire
         os.environ["BLUEFOG_WINDOW_WIRE"] = "int4"
         os.environ["BLUEFOG_METRICS"] = "0"
@@ -4339,6 +4436,7 @@ def run_quant() -> int:
         print(json.dumps({
             "metric": "quant_window_mass",
             "wire": "int4",
+            "wire_kernels_on": wire_kernels.wire_kernels_on(),
             "n_workers": n,
             "dim": dim,
             "ps_steps": ps_steps,
@@ -4370,6 +4468,17 @@ def run_quant() -> int:
             f"push-sum mass drift {max_drift:.3e} exceeds the f32 "
             f"rounding bound {mass_bound:.3e} under the int4 window wire"
         )
+        for row in kernel_rows:
+            assert row["bitwise_equal"], (
+                f"fused wire kernels changed the {row['wire']} combine "
+                "bitwise — the same-bits contract is broken"
+            )
+            assert row["fused_below_fp32_row"], (
+                f"fused {row['wire']} scratch "
+                f"{row['temp_bytes_fused']} B is not below the fp32 "
+                f"row's {row['temp_bytes_fp32']} B — the full-width "
+                "temporary still materializes"
+            )
     return 0
 
 
@@ -4813,26 +4922,38 @@ def run_memory() -> int:
     full_width = 4 * dim_wire  # the f32 temporary fusion eliminates
     temps = {}
     wire_rows = []
-    for wire in (None, "int8", "int4"):
-        name = wire or "fp32"
-        t = temp_bytes(wire)
-        temps[name] = t
-        wire_rows.append({
-            "metric": "memory_wire_temps",
-            "wire": name,
-            "payload_elems": dim_wire,
-            "temp_bytes_measured": t,
-            "temp_bytes_analytic": scaling.quantized_temporaries_bytes(
-                dim_wire, wire
-            ),
-            "full_width_bytes": full_width,
-            "wire_bytes_per_round": scaling.wire_payload_bytes(
-                dim_wire, 4, wire
-            ),
-            "extra_vs_exact_bytes": t - temps["fp32"],
-            "full_width_temporary_materializes": t >= full_width,
-        })
-        print(json.dumps(wire_rows[-1]))
+    # pin the fused kernels OFF: these rows are the committed COMPOSITE
+    # before-baseline (the fused numbers live in QUANT_EVIDENCE's
+    # quant_kernel rows); wire_kernels_on() reads the env per trace, so
+    # the fresh lambdas above retrace under the pin
+    old_wk = os.environ.get("BLUEFOG_WIRE_KERNELS")
+    os.environ["BLUEFOG_WIRE_KERNELS"] = "0"
+    try:
+        for wire in (None, "int8", "int4"):
+            name = wire or "fp32"
+            t = temp_bytes(wire)
+            temps[name] = t
+            wire_rows.append({
+                "metric": "memory_wire_temps",
+                "wire": name,
+                "payload_elems": dim_wire,
+                "temp_bytes_measured": t,
+                "temp_bytes_analytic": (
+                    scaling.quantized_temporaries_bytes(dim_wire, wire)
+                ),
+                "full_width_bytes": full_width,
+                "wire_bytes_per_round": scaling.wire_payload_bytes(
+                    dim_wire, 4, wire
+                ),
+                "extra_vs_exact_bytes": t - temps["fp32"],
+                "full_width_temporary_materializes": t >= full_width,
+            })
+            print(json.dumps(wire_rows[-1]))
+    finally:
+        if old_wk is None:
+            os.environ.pop("BLUEFOG_WIRE_KERNELS", None)
+        else:
+            os.environ["BLUEFOG_WIRE_KERNELS"] = old_wk
     wire_summary = {
         "metric": "memory_wire_summary",
         "payload_elems": dim_wire,
@@ -4845,10 +4966,11 @@ def run_memory() -> int:
             if r["wire"] != "fp32"
         ),
         "note": (
-            "composite quantize->pack->ppermute->unpack scratch, the "
-            "before-baseline for the kernel-fused wire path (ROADMAP "
-            "item 2); a fused kernel must land temp_bytes below the "
-            "fp32 row, not above it"
+            "composite quantize->pack->ppermute->unpack scratch, "
+            "measured with BLUEFOG_WIRE_KERNELS=0 — the retained "
+            "before-baseline for the fused wire kernels; the fused "
+            "path's measurement (temp_bytes below the fp32 row) lives "
+            "in QUANT_EVIDENCE's quant_kernel rows"
         ),
     }
     print(json.dumps(wire_summary))

@@ -608,12 +608,14 @@ class AsyncGossipEngine:
         from bluefog_tpu import flight as flight_mod
         from bluefog_tpu import windows as win_mod
 
+        from bluefog_tpu.collective import kernels as wire_kernels
+
         key = (
             "async_tick", self._uid, getattr(self.opt, "_tx_version", 0),
             perms, tuple(map(tuple, slot_table)), self.wire,
             self.has_aux, n_batch, state_aval, batch_aval,
             win.shape, str(win.dtype),
-        )
+        ) + wire_kernels.cache_token(self.wire)
         fn = ctx.op_cache.get(key)
         if fn is not None:
             return fn

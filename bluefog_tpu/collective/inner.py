@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 import jax.numpy as jnp
 from jax import lax
 
+from bluefog_tpu.collective import kernels as _kernels
 from bluefog_tpu.collective.plan import CommPlan, SchedulePlan
 
 __all__ = [
@@ -415,11 +416,35 @@ def _dequant8(q, s, n):
     return (q.astype(jnp.float32) * s[:, None]).reshape(-1)[:n]
 
 
-def _block_quantizer(wire):
-    """(quantize, dequantize) pair of a block-scaled integer wire."""
+def _composite_block_quantizer(wire):
+    """The composite (non-kernel) quantizer pair — the EF receivers
+    integrate through this unconditionally: their ``hat + dequant``
+    bits depend on XLA:CPU's fusion-contraction decisions, which a
+    kernel-materialized dequant buffer changes (observed: 1-ulp flips
+    in the EF accumulate when ``hat_r`` reads a Pallas output instead
+    of the inline expression), and the bitwise kernel-on == kernel-off
+    pin outranks fusing a non-gated surface."""
     if wire == "int4":
         return _chunk_quantize4, _dequant4
     return _chunk_quantize, _dequant8
+
+
+def _block_quantizer(wire):
+    """(quantize, dequantize) pair of a block-scaled integer wire.
+
+    THE gating point for the fused Pallas wire
+    (:mod:`bluefog_tpu.collective.kernels`): when the kernels are on
+    (``BLUEFOG_WIRE_KERNELS``, default auto) every surface that
+    quantizes through here — the combines' chunked wavefronts, the
+    window exchange, allgather, the hierarchical combine — encodes and
+    decodes through the fused kernels instead of the composite op
+    chains. Same wire bits, same reconstruction bits (the kernel bodies
+    replicate this module's arithmetic op for op; pinned bitwise in
+    tests/test_wire_kernels.py), so flipping the flag can never change
+    a trajectory — only the staging the program materializes."""
+    if wire in ("int8", "int4") and _kernels.wire_kernels_on():
+        return _kernels.block_quantizer(wire)
+    return _composite_block_quantizer(wire)
 
 
 def weighted_combine_quantized_ef_operands(
@@ -469,7 +494,10 @@ def weighted_combine_quantized_ef_operands(
         raise ValueError(
             f"error-feedback wire must be 'int8' or 'int4', got {wire!r}"
         )
-    quantize, dequant = _block_quantizer(wire)
+    # the composite pair unconditionally: the EF receive side's bits
+    # are fusion-contraction-sensitive (see _composite_block_quantizer);
+    # the kernel contribution to this surface is the fused SENDER below
+    quantize, dequant = _composite_block_quantizer(wire)
     wdt = _weight_dtype(x)
     idx = lax.axis_index(axis_name)
     xw = x.astype(wdt)
@@ -478,6 +506,34 @@ def weighted_combine_quantized_ef_operands(
     n = xf.size
     bounds = chunk_bounds(n, chunks)
     if len(bounds) == 1:
+        if _kernels.wire_kernels_on():
+            # fused EF sender: the difference, its quantize, and the
+            # copy integration h + Q(x - h) all happen in one kernel —
+            # neither the full-width diff nor its dequantized update
+            # (the composite's dhat) ever materializes, and xhat_self
+            # integrates from the very q the wire ships (the PR-8
+            # identical-bits contract). The RECEIVE side deliberately
+            # keeps the composite inline expression: a materialized
+            # dequant buffer changes XLA:CPU's fusion-contraction
+            # context and flips 1-ulp bits in the accumulate, breaking
+            # the kernel-on == kernel-off pin (and the EF receive
+            # staging is not a gated temporary — the hat copies are
+            # required state, not scratch).
+            q, sc, xhat_self_new = _kernels.encode_diff(
+                xf, xhat_self, wire
+            )
+            y = xw
+            new_recv = []
+            for r, perm in enumerate(perms):
+                recv_q = lax.ppermute(q, axis_name, perm)
+                recv_s = lax.ppermute(sc, axis_name, perm)
+                hat_r = xhat_recv[r] + dequant(recv_q, recv_s, n)
+                new_recv.append(hat_r)
+                y = y + (
+                    (hat_r - xhat_self_new).reshape(x.shape).astype(wdt)
+                    * recv_w[r, idx].astype(wdt)
+                )
+            return y, (xhat_self_new, jnp.stack(new_recv))
         q, sc, dhat = quantize(xf - xhat_self)
         xhat_self_new = xhat_self + dhat
         y = xw
@@ -640,6 +696,24 @@ def weighted_combine_quantized_operands(
     xf = xw.astype(jnp.float32)
     n = xf.size
     if chunks <= 1 and inject is None:
+        if _kernels.wire_kernels_on():
+            # the fully fused monolithic path: fused encode, then ALL
+            # receive rounds folded into one decode+accumulate kernel —
+            # no full-width dequantized temporary, neither for the
+            # received payloads nor for xhat_self (re-decoded from the
+            # sender's own packed buffer in-kernel). Bitwise the
+            # composite loop below (tests/test_wire_kernels.py).
+            q, s = _kernels.encode(xf.ravel(), wire)
+            rounds = [
+                (
+                    lax.ppermute(q, axis_name, perm),
+                    lax.ppermute(s, axis_name, perm),
+                )
+                for perm in perms
+            ]
+            return _kernels.decode_accumulate(
+                xw, q, s, rounds, recv_w[:, idx], wire
+            )
         q, s, xhat_flat = quantize(xf.ravel())
 
         def dequant(qq, ss):

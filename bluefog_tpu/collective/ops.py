@@ -33,6 +33,7 @@ from bluefog_tpu import metrics
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import watchdog
 from bluefog_tpu.collective import compiler, inner
+from bluefog_tpu.collective import kernels as wire_kernels
 from bluefog_tpu.collective.plan import (
     CommPlan,
     plan_from_topology,
@@ -509,7 +510,8 @@ def neighbor_allreduce_nonblocking(
     combine = _combine_for(compression, chunks)
     fn = _compiled(
         ctx, "neighbor_allreduce",
-        (plan, compression, chunks, route) + _aval_key(x),
+        (plan, compression, chunks, route) + _aval_key(x)
+        + wire_kernels.cache_token(compression),
         lambda xb: combine(xb, plan, ctx_mod.WORKER_AXIS),
         in_specs=P(ctx_mod.WORKER_AXIS), out_specs=P(ctx_mod.WORKER_AXIS),
     )
@@ -570,7 +572,9 @@ def neighbor_allgather_nonblocking(
             )
     plan = _static_plan(ctx)
     fn = _compiled(
-        ctx, "neighbor_allgather", (plan, compression) + _aval_key(x),
+        ctx, "neighbor_allgather",
+        (plan, compression) + _aval_key(x)
+        + wire_kernels.cache_token(compression),
         lambda xb: inner.neighbor_allgather(
             xb, plan, ctx_mod.WORKER_AXIS, wire=compression
         ),

@@ -1,0 +1,122 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""The ONE numpy reference of the block-scaled packed-wire format.
+
+Three replays of the 512-block quantized wire grew independently — the
+metrics drain's quant-error fold (``metrics._np_chunk_quantize*``), the
+windows tests' win_put oracle, and the bench evidence replays — each
+re-implementing absmax -> scale -> quantize -> nibble-pack by hand. A
+format change (scale snap, nibble layout) could silently drift one of
+them. This module is the single host-side source of truth they all
+delegate to, and the oracle ``tests/test_wire_kernels.py`` pins BOTH
+device paths (composite ``inner._chunk_quantize*`` and the fused Pallas
+``collective.kernels``) against, bit for bit.
+
+Format (identical to the device quantizers — see ``inner._chunk_quantize``
+/ ``inner._chunk_quantize4`` for the rationale of every choice):
+
+- flat payload zero-padded to 512-element blocks (``ROW``);
+- int8: per-block scale ``max|x|.clip(tiny) / 127`` shipped in f32,
+  lanes ``clip(round(x / s), -127, 127)``;
+- int4: scale ``max|x|.clip(tiny) / 7`` snapped to bf16 BEFORE
+  quantizing (sender and receivers reconstruct from identical bits),
+  lanes in [-7, 7] packed two nibbles per int8 lane in the
+  deinterleaved-halves layout: block element ``k`` rides the LOW nibble
+  of lane ``k``, element ``256 + k`` the HIGH nibble; unpack
+  sign-extends with arithmetic shifts and concatenates the two halves.
+
+Pure numpy (+ml_dtypes for bf16), no JAX import: usable from host
+drains, pytest ovens and bench subprocesses alike.
+"""
+
+import numpy as np
+
+__all__ = [
+    "ROW",
+    "np_pack_nibbles",
+    "np_unpack_nibbles",
+    "np_encode",
+    "np_decode",
+    "np_chunk_quantize",
+    "np_chunk_quantize4",
+]
+
+# Must equal inner._QUANT_CHUNK and kernels.CHUNK (asserted in
+# tests/test_wire_kernels.py): one scale grid across every replica.
+ROW = 512
+
+
+def np_pack_nibbles(q):
+    """[n_chunks, 512] int4 values in int8 storage -> [n_chunks, 256]
+    packed int8 (deinterleaved-halves layout)."""
+    half = q.shape[1] // 2
+    lo = q[:, :half] & np.int8(0x0F)
+    hi = np.left_shift(q[:, half:], 4).astype(np.int8)
+    return lo | hi
+
+
+def np_unpack_nibbles(p):
+    """Inverse of :func:`np_pack_nibbles` (arithmetic shifts sign-extend
+    the nibbles back to [-8, 7])."""
+    lo = np.right_shift(np.left_shift(p, 4).astype(np.int8), 4)
+    hi = np.right_shift(p, 4)
+    return np.concatenate([lo, hi], axis=1)
+
+
+def _blocks(xf):
+    n = xf.size
+    n_chunks = -(-n // ROW)
+    flat = np.pad(np.asarray(xf, np.float32).ravel(),
+                  (0, n_chunks * ROW - n))
+    return flat.reshape(n_chunks, ROW), n
+
+
+def np_encode(xf, wire):
+    """Flat vector -> ``(payload, scales, xhat)`` in the device wire
+    format: int8 -> ([n_chunks, 512] int8, [n_chunks] f32); int4 ->
+    ([n_chunks, 256] packed int8, [n_chunks] bf16). ``xhat`` is the
+    flat [n] f32 reconstruction (what the sender keeps and every
+    receiver rebuilds from the same bits)."""
+    import ml_dtypes
+
+    resh, n = _blocks(xf)
+    if wire in ("int4", "int4_ef"):
+        s = np.maximum(
+            np.max(np.abs(resh), axis=1), np.finfo(np.float32).tiny
+        ) / 7.0
+        s16 = s.astype(ml_dtypes.bfloat16)
+        sw = s16.astype(np.float32)
+        q = np.clip(np.round(resh / sw[:, None]), -7, 7).astype(np.int8)
+        payload = np_pack_nibbles(q)
+        return payload, s16, np_decode(payload, s16, n, "int4")
+    s = np.maximum(
+        np.max(np.abs(resh), axis=1), np.finfo(np.float32).tiny
+    ) / 127.0
+    q = np.clip(np.round(resh / s[:, None]), -127, 127).astype(np.int8)
+    s = s.astype(np.float32)
+    return q, s, np_decode(q, s, n, "int8")
+
+
+def np_decode(payload, scales, n, wire):
+    """Wire pair -> flat [n] f32 reconstruction (exact f32 arithmetic,
+    insensitive to evaluation order — the device decoders share this
+    property, which is what makes the oracle a bitwise one)."""
+    if wire in ("int4", "int4_ef"):
+        q = np_unpack_nibbles(payload)
+    else:
+        q = payload
+    sw = np.asarray(scales).astype(np.float32)
+    return (q.astype(np.float32) * sw[:, None]).reshape(-1)[:n]
+
+
+def np_chunk_quantize(xf):
+    """Reconstruction-only int8 replay (the metrics drain's historical
+    signature)."""
+    _q, _s, xhat = np_encode(xf, "int8")
+    return xhat
+
+
+def np_chunk_quantize4(xf):
+    """Reconstruction-only int4 replay, through the pack/unpack pair so
+    the replay exercises the exact wire format."""
+    _q, _s, xhat = np_encode(xf, "int4")
+    return xhat

@@ -416,63 +416,37 @@ def build_probe_payload(pairs, g_subs, wire=None):
 def _np_chunk_quantize(xf):
     """Host-side replica of
     :func:`bluefog_tpu.collective.inner._chunk_quantize` (same chunking,
-    same zero-guard) for the drain-time quantization-error fold."""
-    import numpy as np
+    same zero-guard) for the drain-time quantization-error fold —
+    delegates to the shared packed-wire reference
+    (:mod:`bluefog_tpu.collective.wire_ref`), the single numpy source of
+    truth the device paths are pinned against."""
+    from bluefog_tpu.collective import wire_ref
 
-    n = xf.size
-    n_chunks = -(-n // _ROW)
-    flat = np.pad(xf.astype(np.float32), (0, n_chunks * _ROW - n))
-    resh = flat.reshape(n_chunks, _ROW)
-    s = np.maximum(
-        np.max(np.abs(resh), axis=1), np.finfo(np.float32).tiny
-    ) / 127.0
-    q = np.clip(np.round(resh / s[:, None]), -127, 127).astype(np.int8)
-    xhat = (q.astype(np.float32) * s[:, None]).reshape(-1)[:n]
-    return xhat
+    return wire_ref.np_chunk_quantize(xf)
 
 
 def _np_pack_nibbles(q):
-    """Host replica of ``inner._pack_nibbles``: [n_chunks, 512] int4
-    values in int8 storage -> [n_chunks, 256] packed int8 (deinterleaved
-    halves layout: element k in the low nibble of lane k, element
-    half+k in the high nibble)."""
-    import numpy as np
+    """Host replica of ``inner._pack_nibbles`` (shared reference —
+    see :mod:`bluefog_tpu.collective.wire_ref`)."""
+    from bluefog_tpu.collective import wire_ref
 
-    half = q.shape[1] // 2
-    lo = q[:, :half] & np.int8(0x0F)
-    hi = np.left_shift(q[:, half:], 4).astype(np.int8)
-    return lo | hi
+    return wire_ref.np_pack_nibbles(q)
 
 
 def _np_unpack_nibbles(p):
-    """Host replica of ``inner._unpack_nibbles`` (arithmetic shifts
-    sign-extend the nibbles back)."""
-    import numpy as np
+    """Host replica of ``inner._unpack_nibbles`` (shared reference)."""
+    from bluefog_tpu.collective import wire_ref
 
-    lo = np.right_shift(np.left_shift(p, 4).astype(np.int8), 4)
-    hi = np.right_shift(p, 4)
-    return np.concatenate([lo, hi], axis=1)
+    return wire_ref.np_unpack_nibbles(p)
 
 
 def _np_chunk_quantize4(xf):
     """Host-side replica of ``inner._chunk_quantize4`` — int4 nibbles
     against the bf16-snapped block scale, through the pack/unpack pair
-    so the replay exercises the exact wire format."""
-    import ml_dtypes
-    import numpy as np
+    so the replay exercises the exact wire format (shared reference)."""
+    from bluefog_tpu.collective import wire_ref
 
-    n = xf.size
-    n_chunks = -(-n // _ROW)
-    flat = np.pad(xf.astype(np.float32), (0, n_chunks * _ROW - n))
-    resh = flat.reshape(n_chunks, _ROW)
-    s = np.maximum(
-        np.max(np.abs(resh), axis=1), np.finfo(np.float32).tiny
-    ) / 7.0
-    sw = s.astype(ml_dtypes.bfloat16).astype(np.float32)
-    q = np.clip(np.round(resh / sw[:, None]), -7, 7).astype(np.int8)
-    q = _np_unpack_nibbles(_np_pack_nibbles(q))
-    xhat = (q.astype(np.float32) * sw[:, None]).reshape(-1)[:n]
-    return xhat
+    return wire_ref.np_chunk_quantize4(xf)
 
 
 # Every wire tier with a quant-error replay; the _ef members additionally

@@ -949,7 +949,13 @@ class _GossipOptimizer:
                         )
                     ef_wire = "int4" if wire == "int4_ef" else "int8"
                     return (
-                        ("na_q_ef", ef_wire, perms, chunks),
+                        # the kernel token rides at the END of every
+                        # quantized gossip key (flows into the opt_step
+                        # key via tuple(gossip_key); _metrics_wire
+                        # parses wire positionally at [1], so appending
+                        # is the only safe spot)
+                        ("na_q_ef", ef_wire, perms, chunks)
+                        + inner._kernels.cache_token(ef_wire),
                         lambda flat, e, wops: (
                             inner.weighted_combine_quantized_ef_operands(
                                 flat, e, perms, wops[0],
@@ -960,7 +966,8 @@ class _GossipOptimizer:
                         (jnp.asarray(recv_w),),
                     )
                 return (
-                    ("na_q", wire, perms, chunks, inject),
+                    ("na_q", wire, perms, chunks, inject)
+                    + inner._kernels.cache_token(wire),
                     lambda t, step, wops: (
                         inner.weighted_combine_quantized_operands(
                             t, perms, wops[0], ctx_mod.WORKER_AXIS,
@@ -1096,7 +1103,8 @@ class _GossipOptimizer:
             )
             wire = self.compression
             return (
-                ("hier_q", wire, perms),
+                ("hier_q", wire, perms)
+                + inner._kernels.cache_token(wire),
                 lambda t, step, wops: (
                     inner.hierarchical_neighbor_allreduce_quantized(
                         t, perms, wops[0],

@@ -148,7 +148,8 @@ def wire_bytes_per_step(n_elems_by_itemsize, n_rounds: int,
     return per_round * n_rounds
 
 def quantized_temporaries_bytes(n_elems: int,
-                                wire: Optional[str] = None) -> int:
+                                wire: Optional[str] = None,
+                                fused: bool = False) -> int:
     """Analytic bytes of the full-width temporaries the COMPOSITE
     quantized wire path materializes per round today — the
     quantize → pack → ppermute → unpack → dequant chain runs as
@@ -165,6 +166,15 @@ def quantized_temporaries_bytes(n_elems: int,
     Block-scaled tiers stage whole 512-element blocks (the payload is
     padded to the scale grid before the ppermute). fp32 ships verbatim
     — no conversion temporaries — and returns 0.
+
+    ``fused=True`` prices the kernel-fused wire instead
+    (``BLUEFOG_WIRE_KERNELS``, :mod:`bluefog_tpu.collective.kernels`):
+    the encode kernel writes the packed wire buffer + scale sidecar
+    directly and the decode+accumulate kernel folds each received
+    payload into the accumulator in one pass, so the only temporaries
+    are the local packed buffer + sidecar and one in-flight received
+    copy of the same — **no full-width reconstruction ever exists**.
+    bf16/fp32 have no fused path and price identically.
     """
     from bluefog_tpu.collective.inner import _QUANT_CHUNK
 
@@ -173,6 +183,17 @@ def quantized_temporaries_bytes(n_elems: int,
     if wire in ("int8", "int8_ef", "int4", "int4_ef"):
         blocks = -(-int(n_elems) // _QUANT_CHUNK)
         padded = blocks * _QUANT_CHUNK
+        if fused:
+            # local packed buffer + scale sidecar, times two: the
+            # encode output and the in-flight received copy the
+            # decode+accumulate kernel reads. No full-width staging.
+            if wire in ("int4", "int4_ef"):
+                packed = padded // 2     # nibble-packed lanes
+                sidecar = blocks * 2     # bf16 scale per block
+            else:
+                packed = padded          # int8 lanes
+                sidecar = blocks * 4     # f32 scale per block
+            return 2 * (packed + sidecar)
         full_width = 4 * padded      # f32 dequant of the received payload
         staging = padded             # int8 quantize output pre-send
         if wire in ("int4", "int4_ef"):

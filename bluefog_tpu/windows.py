@@ -537,7 +537,7 @@ def _exchange_fn(ctx, win: _Window, mode: str, perms, slot_table,
         "win_exchange", mode, perms,
         tuple(map(tuple, slot_table)), update_p, wire,
         win.shape, str(win.dtype),
-    )
+    ) + inner._kernels.cache_token(wire)
     cached = ctx.op_cache.get(key)
     if cached is not None:
         return cached

@@ -40,6 +40,22 @@ jax.config.update("jax_platforms", _platform)
 import pytest  # noqa: E402
 
 
+def require_pallas():
+    """Skip the calling test when Pallas cannot be imported.
+
+    The wire-kernel tests run the kernels in ``interpret=True`` mode on
+    CPU, which still needs ``jax.experimental.pallas`` importable — a
+    CPU-only jaxlib build without the Pallas extension should skip, not
+    fail. Collection itself must never import Pallas (the suite has to
+    collect everywhere), so tests call this helper at the top of the
+    test body / fixture instead of importing kernels at module scope.
+    """
+    return pytest.importorskip(
+        "jax.experimental.pallas",
+        reason="jax.experimental.pallas unavailable on this jaxlib",
+    )
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     return jax.devices("cpu")

@@ -520,6 +520,32 @@ def _check_quant(lines):
     mass = [l for l in lines if l.get("metric") == "quant_window_mass"]
     assert mass and mass[0]["mass_conserved"] is True, lines
     assert mass[0]["max_mass_drift"] < mass[0]["mass_bound"]
+    # fused wire kernels (BLUEFOG_WIRE_KERNELS): kernel-vs-composite
+    # rows carry the bitwise pin and the scratch gate — fused temp
+    # bytes BELOW the fp32 row for int8 AND int4 (the full-width
+    # temporary never materializes), with the analytic fused model
+    # re-derived against the committed columns
+    from bluefog_tpu import scaling
+
+    kern = {
+        l["wire"]: l for l in lines if l.get("metric") == "quant_kernel"
+    }
+    assert set(kern) == {"int8", "int4"}, sorted(kern)
+    for name, r in kern.items():
+        assert r["bitwise_equal"] is True, r
+        assert r["fused_below_fp32_row"] is True, r
+        assert r["temp_bytes_fused"] < r["temp_bytes_fp32"], r
+        assert r["temp_bytes_fused"] < r["temp_bytes_composite"], r
+        # the composite row still stages the full-width reconstruction
+        assert r["temp_bytes_composite"] >= 4 * r["payload_elems"], r
+        assert r["temp_bytes_analytic_fused"] == (
+            scaling.quantized_temporaries_bytes(
+                r["payload_elems"], name, fused=True
+            )
+        ), r
+        assert r["temp_bytes_analytic_composite"] == (
+            scaling.quantized_temporaries_bytes(r["payload_elems"], name)
+        ), r
     anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
     assert anchor and anchor[0]["tflops"] > 0
 
@@ -634,6 +660,48 @@ def test_bench_diff_wire_columns_are_tooling_gained(tmp_path):
         path.write_text(
             json.dumps(prov) + "\n" + json.dumps(row) + "\n"
         )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
+def test_bench_diff_wire_kernel_columns_are_tooling_gained(tmp_path):
+    """The fused-wire-kernel evidence (quant_kernel rows +
+    kernel-vs-composite scratch/step-time columns) against a pre-kernel
+    QUANT_EVIDENCE artifact must read as tooling-gained
+    (WIRE_KERNEL_DERIVED), never a comparability break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare, WIRE_KERNEL_DERIVED, TOOLING_DERIVED
+
+    assert WIRE_KERNEL_DERIVED <= TOOLING_DERIVED
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_kernel_evidence):
+        tier = {
+            "metric": "quant_tier", "wire": "int4", "n_workers": 8,
+            "final_consensus_median": 13.0,
+        }
+        rows = [prov, tier]
+        if with_kernel_evidence:
+            # the columns on an existing cell AND the new metric rows
+            tier = dict(tier, step_time_fused_us=2653.8,
+                        temp_bytes_fused=6344)
+            rows = [prov, tier, {
+                "metric": "quant_kernel", "wire": "int4",
+                "temp_bytes_composite": 20640, "temp_bytes_fused": 6344,
+                "temp_bytes_fp32": 16384, "bitwise_equal": True,
+            }]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
         return str(path)
 
     old = artifact(tmp_path / "old.json", False)

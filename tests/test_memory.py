@@ -139,6 +139,30 @@ def test_quantized_temporaries_bytes_model():
         scaling.wire_payload_bytes(4096, 4, "int8")
 
 
+def test_quantized_temporaries_bytes_fused_arm():
+    """``fused=True`` prices the kernel wire: 2x (packed buffer +
+    scale sidecar) — the encode output plus the one in-flight received
+    copy — and never a full-width reconstruction. It must land below
+    both the composite model AND the raw fp32 payload (4 B/elem), the
+    BENCH_ASSERT gate the evidence run enforces on measured bytes."""
+    f = scaling.quantized_temporaries_bytes
+    # int8: packed = padded int8 lanes, sidecar = f32 scale per block
+    assert f(4096, "int8", fused=True) == 2 * (4096 + (4096 // 512) * 4)
+    # int4: half-width lanes, bf16 scale per block
+    assert f(4096, "int4", fused=True) == 2 * (2048 + (4096 // 512) * 2)
+    for wire in ("int8", "int4"):
+        assert f(4096, wire + "_ef", fused=True) == f(4096, wire, fused=True)
+        assert f(4096, wire, fused=True) < f(4096, wire)
+        assert f(4096, wire, fused=True) < 4 * 4096  # under the fp32 payload
+    # padding still rounds up to the 512-element scale grid
+    assert f(100, "int8", fused=True) == 2 * (512 + 4)
+    assert f(100, "int4", fused=True) == 2 * (256 + 2)
+    # no fused path for bf16/fp32 — priced identically
+    assert f(4096, "bf16", fused=True) == f(4096, "bf16")
+    assert f(4096, None, fused=True) == 0
+    assert f(0, "int4", fused=True) == 0
+
+
 # -- census + reconciliation --------------------------------------------------
 
 

@@ -143,10 +143,23 @@ MEMORY_DERIVED = {
     "temp_bytes_analytic", "full_width_bytes", "headroom_bytes",
 }
 
+# Fused-wire-kernel columns that arrived with the quant_kernel rows
+# (BLUEFOG_WIRE_KERNELS, BENCH_MODE=quant): kernel-vs-composite scratch
+# readings, analytic fused-staging models and step-time pairings are
+# compile-time/memory bookkeeping new to the kernel evidence, so their
+# one-sided appearance against a pre-kernel QUANT_EVIDENCE artifact is
+# the tooling gaining a column — never a comparability break.
+WIRE_KERNEL_DERIVED = {
+    "temp_bytes_composite", "temp_bytes_fused", "temp_bytes_fp32",
+    "temp_bytes_analytic_fused", "temp_bytes_analytic_composite",
+    "step_time_composite_us", "step_time_fused_us",
+}
+
 # Every one-sided-tolerated derived column set.
 TOOLING_DERIVED = (
     ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
     | ASYNC_DERIVED | SHARD_DERIVED | MEMORY_DERIVED
+    | WIRE_KERNEL_DERIVED
 )
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")

@@ -31,6 +31,20 @@ import sys
 
 __all__ = ["scan_overlap", "COMPUTE_OPS"]
 
+# Pallas kernels (the fused quantized wire, the flash-attention blocks)
+# survive to optimized HLO as opaque `custom-call`s — Mosaic's
+# ``tpu_custom_call`` on TPU, Triton's on GPU — rather than any op kind
+# above. They are real compute a transfer can hide behind, so the
+# parser rewrites recognized targets to this dedicated kind and counts
+# it as compute; the overlap verdicts themselves are unchanged.
+# (CPU ``interpret=True`` kernels discharge to plain fusions and need
+# no special case.)
+PALLAS_OP = "custom-call.pallas"
+_PALLAS_TARGET_RE = re.compile(
+    r'custom_call_target="(?:tpu_custom_call|mosaic[^"]*'
+    r'|__gpu\$xla\.gpu\.triton)"'
+)
+
 # Instruction kinds that represent real compute an overlapped transfer
 # could hide behind (elementwise chains are fused into `fusion` on every
 # backend that matters).
@@ -42,6 +56,7 @@ COMPUTE_OPS = (
     "reduce-window",
     "scatter",
     "select-and-scatter",
+    PALLAS_OP,
 )
 
 _DTYPE_BYTES = {
@@ -101,6 +116,8 @@ def _parse_computations(hlo_text: str):
         if not mi:
             continue
         name, shape_text, op, rest = mi.groups()
+        if op == "custom-call" and _PALLAS_TARGET_RE.search(rest):
+            op = PALLAS_OP
         # operands live before the first `), attr=` break; good enough to
         # take every %ref on the line minus the instruction's own name
         operands = [o for o in _OPERAND_RE.findall(rest)]
@@ -199,9 +216,14 @@ def scan_overlap(hlo_text: str) -> dict:
                     "compute_between": 0,
                     "independent_compute_ops": independent,
                 })
+    pallas_calls = sum(
+        1 for instrs in comps.values()
+        for _n, op, _sh, _ops, _p in instrs if op == PALLAS_OP
+    )
     async_pairs = [p for p in permutes if p["kind"] == "async"]
     return {
         "async_pairs": len(async_pairs),
+        "pallas_custom_calls": pallas_calls,
         "overlapped_async_pairs": sum(
             1 for p in async_pairs if p["compute_between"] > 0
         ),
