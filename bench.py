@@ -4484,7 +4484,7 @@ def run_quant() -> int:
 
 def run_shard() -> int:
     """Weight-update-sharding evidence (``BENCH_MODE=shard``, committed
-    as SHARD_EVIDENCE.json). Four facts, BENCH_ASSERT-gated:
+    as SHARD_EVIDENCE.json). Five facts, BENCH_ASSERT-gated:
 
     1. *Memory*: on an 8-worker mesh, Adam state for a model whose
        REPLICATED per-rank footprint exceeds a simulated per-chip
@@ -4493,12 +4493,21 @@ def run_shard() -> int:
        1/N + the disclosed 512-alignment slack.
     2. *Trajectory*: the sharded run matches the replicated run AND the
        numpy Adam oracle coordinate-for-coordinate (ulp envelope) —
-       sharding is a memory layout, not an algorithm change.
+       sharding is a memory layout, not an algorithm change. The ZeRO-2
+       run (``BLUEFOG_SHARD_GRADS=1``, gradient leg lowered to
+       reduce-scatter) sits inside the SAME envelope.
     3. *Step time*: sharded vs unsharded at the same model size stays
        within the disclosed A/A noise floor (the 1/N update saving and
        the all-gather cost trade against each other on CPU).
     4. *Off pin*: ``BLUEFOG_SHARD=0`` dispatches bitwise-identically
        with zero shard-tagged cache keys.
+    5. *Gradient wire* (``shard_grad_wire``): the dispatched
+       reduce-scatter delivers a measured per-rank reduced-gradient
+       buffer at ~1/N of the allreduce's (pad slack disclosed);
+       reduce-scatter + all-gather wire <= allreduce + all-gather; and
+       the quantized scatter tiers price at the exact block-scale
+       ratios (int8 = 516/2048, int4 = 258/2048 — slots are 512-grid
+       multiples so the ratios are exact, not approximate).
 
     See docs/sharding.md."""
     if os.environ.get("BENCH_SCALING_PLATFORM", "cpu") != "native":
@@ -4529,14 +4538,17 @@ def run_shard() -> int:
     c = rng.randn(n, dim).astype(np.float32)
     c_mean = c.mean(axis=0)
 
-    def session(shard, body):
+    def session(shard, body, grads=False):
         os.environ["BLUEFOG_SHARD"] = "1" if shard else "0"
+        if grads:
+            os.environ["BLUEFOG_SHARD_GRADS"] = "1"
         bf.init(devices=devices[:n])
         try:
             return body()
         finally:
             bf.shutdown()
             os.environ.pop("BLUEFOG_SHARD", None)
+            os.environ.pop("BLUEFOG_SHARD_GRADS", None)
 
     def make(shard_unused=None):
         opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(lr))
@@ -4566,6 +4578,10 @@ def run_shard() -> int:
         loss0 = loss_of(params)
         for _ in range(steps):
             params, state = opt.step(params, state, grads_of(params))
+            # one multi-device program in flight at a time: overlapped
+            # 8-participant rendezvous can starve each other on a
+            # small host
+            jax.block_until_ready(params)
         w = np.asarray(params["w"])
         return {
             "measured": measured, "analytic": analytic,
@@ -4632,10 +4648,15 @@ def run_shard() -> int:
             params, state = opt.step(
                 params, state, {"w": params["w"] - jnp.asarray(ct)}
             )
+            jax.block_until_ready(params)
         return np.asarray(params["w"])[0]
 
     w_sh = session(True, lambda: traj(True))
     w_rp = session(False, lambda: traj(False))
+    # ZeRO-2: the same trajectory with the gradient leg lowered to
+    # reduce-scatter (BLUEFOG_SHARD_GRADS=1) — the scatter's fixed
+    # reduction order must keep it inside the SAME pin envelope
+    w_z2 = session(True, lambda: traj(True), grads=True)
 
     # numpy oracle: replicated gradient-allreduce Adam on the quadratic
     # (grad of 0.5||x - c_r||^2 allreduce-means to x - mean(c))
@@ -4653,15 +4674,21 @@ def run_shard() -> int:
     traj_tol = 1e-5
     traj_max_dev = float(np.abs(w_sh - w_rp).max())
     oracle_dev = float(np.abs(w_sh - x).max())
+    z2_max_dev = float(np.abs(w_z2 - w_rp).max())
+    z2_oracle_dev = float(np.abs(w_z2 - x).max())
     lines.append({
         "metric": "shard_trajectory",
         "dim": traj_dim,
         "steps": 8,
         "traj_max_dev": traj_max_dev,
         "oracle_max_dev": oracle_dev,
+        "zero2_max_dev": z2_max_dev,
+        "zero2_oracle_max_dev": z2_oracle_dev,
         "tol": traj_tol,
         "sharded_matches_replicated": traj_max_dev <= traj_tol,
         "sharded_matches_numpy_oracle": oracle_dev <= 1e-4,
+        "zero2_matches_replicated": z2_max_dev <= traj_tol,
+        "zero2_matches_numpy_oracle": z2_oracle_dev <= 1e-4,
         "oracle": "numpy replicated-Adam replay",
     })
 
@@ -4675,7 +4702,9 @@ def run_shard() -> int:
                 holder["p"], holder["s"] = opt.step(
                     holder["p"], holder["s"], grads_of(holder["p"])
                 )
-                return holder["p"]["w"]
+                # synchronous per-step timing on both arms: identical
+                # A/B treatment, and no overlapped rendezvous
+                return jax.block_until_ready(holder["p"]["w"])
 
             one()  # compile
             return _timed_differenced(one, t_steps, windows=2)[0]
@@ -4715,6 +4744,7 @@ def run_shard() -> int:
         opt, params, state = make()
         for _ in range(4):
             params, state = opt.step(params, state, grads_of(params))
+            jax.block_until_ready(params)
         keys = [
             k for k in bf.get_context().op_cache
             if isinstance(k, tuple) and "shard" in map(str, k)
@@ -4728,6 +4758,100 @@ def run_shard() -> int:
         "bitwise_identical": bool(np.array_equal(w_off1, w_off2)),
         "shard_tagged_cache_keys": int(k_off1 + k_off2),
         "steps": 4,
+    })
+
+    # -- 5. ZeRO-2 gradient memory + scatter wire ------------------------
+    def grad_mem():
+        """MEASURED (real allocated arrays) reduced-gradient bytes:
+        dispatch the actual reduce-scatter collective on the bench
+        payload and read the delivered buffer's nbytes — the [slot]
+        owned row is the ONLY reduced-gradient buffer the ZeRO-2
+        program materializes, vs the allreduce's full [dim] output."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from bluefog_tpu.collective import inner as inner_mod
+
+        opt, params, state = make()
+        layout = opt._shard_layout
+        assert layout is not None and layout.grads
+        for _ in range(2):
+            params, state = opt.step(params, state, grads_of(params))
+            jax.block_until_ready(params)
+        g = layout.groups[0]
+        ctx = bf.get_context()
+        spec = PartitionSpec("workers")
+        nd = NamedSharding(ctx.mesh, spec)
+        live_index = tuple(
+            int(v) for v in np.asarray(layout.live_index())
+        )
+        xs = np.zeros((n, g.padded), np.float32)
+        xs[:, :dim] = c
+        rs = jax.jit(jax.shard_map(
+            lambda t: inner_mod.reduce_scatter(
+                t[0], "workers", live_index, g.slot
+            )[None],
+            mesh=ctx.mesh, in_specs=spec, out_specs=spec,
+        ))
+        ar = jax.jit(jax.shard_map(
+            lambda t: inner_mod.allreduce(t, "workers", average=True),
+            mesh=ctx.mesh, in_specs=spec, out_specs=spec,
+        ))
+        # one multi-device program in flight at a time: on a small host
+        # two concurrent 8-participant rendezvous can starve each other
+        y_scat = rs(jax.device_put(jnp.asarray(xs), nd))
+        y_scat.block_until_ready()
+        y_full = ar(jax.device_put(jnp.asarray(c), nd))
+        y_full.block_until_ready()
+        # value cross-check: the concatenated delivered slots ARE the
+        # allreduce mean (the two programs compute the same reduction)
+        got = np.asarray(y_scat)[layout.live, :].reshape(-1)[:dim]
+        np.testing.assert_allclose(
+            got, np.asarray(y_full)[0], rtol=0, atol=1e-5
+        )
+        return {
+            "layout": layout,
+            "slot": g.slot,
+            "scat_bytes": int(y_scat.nbytes) // n,
+            "full_bytes": int(y_full.nbytes) // n,
+        }
+
+    gm = session(True, grad_mem, grads=True)
+    layout = gm["layout"]
+    slot = gm["slot"]
+    grad_ratio = gm["scat_bytes"] / gm["full_bytes"]
+    scatter_fp32 = scaling.reduce_scatter_bytes(((slot, 4),), n)
+    allreduce_fp32 = sharding.allreduce_wire_bytes(layout)
+    gather_fp32 = sharding.gather_wire_bytes(layout)
+    tiers = {
+        "fp32": {
+            "scatter_bytes_per_step": scatter_fp32,
+            "ratio_vs_fp32": 1.0,
+        },
+    }
+    for tier in ("bf16", "int8", "int4", "int8_ef", "int4_ef"):
+        b = scaling.reduce_scatter_bytes(((slot, 4),), n, wire=tier)
+        tiers[tier] = {
+            "scatter_bytes_per_step": b,
+            "ratio_vs_fp32": round(b / scatter_fp32, 6),
+        }
+    lines.append({
+        "metric": "shard_grad_wire",
+        "workers": n,
+        "dim": dim,
+        "slot_elems": slot,
+        "grad_bytes_replicated_measured": gm["full_bytes"],
+        "grad_bytes_sharded_measured": gm["scat_bytes"],
+        "grad_ratio_measured": round(grad_ratio, 6),
+        "grad_pad_ratio": round(slot * n / dim - 1.0, 6),
+        "scatter_bytes_per_step": scatter_fp32,
+        "allreduce_bytes_per_step": allreduce_fp32,
+        "gather_bytes_per_step": gather_fp32,
+        "scatter_plus_gather": scatter_fp32 + gather_fp32,
+        "allreduce_plus_gather": allreduce_fp32 + gather_fp32,
+        "wire_le_baseline": (
+            scatter_fp32 + gather_fp32 <= allreduce_fp32 + gather_fp32
+        ),
+        "tiers": tiers,
     })
 
     for line in lines:
@@ -4749,11 +4873,30 @@ def run_shard() -> int:
         trajline = lines[1]
         assert trajline["sharded_matches_replicated"], trajline
         assert trajline["sharded_matches_numpy_oracle"], trajline
+        assert trajline["zero2_matches_replicated"], trajline
+        assert trajline["zero2_matches_numpy_oracle"], trajline
         timeline = lines[2]
         assert timeline["within_noise"], timeline
         offline = lines[3]
         assert offline["bitwise_identical"], offline
         assert offline["shard_tagged_cache_keys"] == 0, offline
+        gw = lines[4]
+        # measured reduced-gradient footprint: exactly slot/dim of the
+        # replicated buffer (both are real f32 arrays, so the ratio is
+        # the geometry itself — no tolerance needed beyond the slack)
+        assert gw["grad_bytes_sharded_measured"] * dim == (
+            gw["grad_bytes_replicated_measured"] * gw["slot_elems"]
+        ), gw
+        assert gw["grad_ratio_measured"] <= 1.0 / n + gw["grad_pad_ratio"] + 1e-6, gw
+        assert gw["wire_le_baseline"], gw
+        assert gw["scatter_bytes_per_step"] < gw["allreduce_bytes_per_step"], gw
+        # block-scale tier ratios are EXACT on the 512 grid
+        assert gw["tiers"]["int8"]["ratio_vs_fp32"] == round(516 / 2048, 6), gw
+        assert gw["tiers"]["int4"]["ratio_vs_fp32"] == round(258 / 2048, 6), gw
+        assert gw["tiers"]["int8_ef"]["ratio_vs_fp32"] == (
+            gw["tiers"]["int8"]["ratio_vs_fp32"]
+        ), gw
+        assert gw["tiers"]["bf16"]["ratio_vs_fp32"] == 0.5, gw
     return 0
 
 

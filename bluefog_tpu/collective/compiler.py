@@ -90,6 +90,9 @@ __all__ = [
     "predicted_round_costs_s",
     "choose_chunks",
     "chunk_option",
+    "CompiledReduceScatter",
+    "compile_reduce_scatter",
+    "reduce_scatter_chunks",
     "calibrate",
     "calibration",
     "set_calibration",
@@ -656,6 +659,7 @@ _COMPILE_CACHE_MAX = 1024
 
 def clear_compile_cache() -> None:
     _COMPILE_CACHE.clear()
+    _RS_CACHE.clear()
 
 
 def compile_edges(
@@ -829,5 +833,79 @@ def choose_chunks(
         congestions = compiled.congestion or (1.0,) * compiled.rounds
     else:
         congestions = (1.0,) * int(compiled)
+    k, _cost = chunk_option(payload_bytes, congestions, n_elems)
+    return k
+
+
+# -- the reduce-scatter family (ZeRO-2 gradient leg) -------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledReduceScatter:
+    """The compiled round structure of one ring reduce-scatter over a
+    ``size`` mesh: ``size - 1`` circulant rounds, round ``t`` shipping
+    each sender's slot for the rank ``t`` positions ahead of it. Every
+    round is a FULL permutation (the ICI fast path, congestion priced
+    by the same route model as the gossip perms), and each rank ships
+    exactly one slot per round — ``(size-1) * slot`` bytes total, half
+    of a bandwidth-optimal allreduce at the same width."""
+
+    perms: Tuple[Tuple[Tuple[int, int], ...], ...]  # per round: (src, dst)
+    size: int
+    rounds: int
+    congestion: Tuple[float, ...]
+    predicted_cost_s: float
+
+
+_RS_CACHE: Dict[int, CompiledReduceScatter] = {}
+
+
+def compile_reduce_scatter(
+    size: int, payload_bytes: Optional[float] = None,
+) -> CompiledReduceScatter:
+    """Compile (and memoize) the circulant reduce-scatter structure for
+    a ``size`` mesh. The structure depends only on the mesh size — the
+    chunk count is chosen per payload by :func:`reduce_scatter_chunks`,
+    exactly like :func:`choose_chunks` prices a gossip plan."""
+    size = int(size)
+    if size < 1:
+        raise ValueError(f"reduce-scatter needs a positive mesh, got {size}")
+    info = _RS_CACHE.get(size)
+    if info is None:
+        perms = tuple(
+            tuple((r, (r + t) % size) for r in range(size))
+            for t in range(1, size)
+        )
+        congestion = _round_congestions(perms, size, "direct")
+        payload = DEFAULT_PAYLOAD_BYTES if payload_bytes is None \
+            else float(payload_bytes)
+        info = CompiledReduceScatter(
+            perms=perms,
+            size=size,
+            rounds=size - 1,
+            congestion=congestion,
+            predicted_cost_s=pipelined_cost_s(payload, 1, congestion),
+        )
+        _RS_CACHE[size] = info
+    return info
+
+
+def reduce_scatter_chunks(
+    size: int,
+    payload_bytes: float,
+    n_elems: Optional[int] = None,
+) -> int:
+    """Chunk count for a reduce-scatter at ``payload_bytes`` per-round
+    slot payload: the calibrated alpha-beta Pareto chooser over the
+    circulant round structure, on the same 512-element grain (a chunk
+    edge off the grid would split a quantized scale block). ``n_elems``
+    is the SLOT width — chunking subdivides the slot each round ships,
+    never the slot assignment itself."""
+    info = compile_reduce_scatter(size, payload_bytes)
+    env = os.environ.get("BLUEFOG_PLAN_CHUNKS", "").strip()
+    if env:
+        return choose_chunks(info.rounds, payload_bytes, n_elems)
+    _maybe_autocalibrate()
+    congestions = info.congestion or (1.0,) * info.rounds
     k, _cost = chunk_option(payload_bytes, congestions, n_elems)
     return k

@@ -119,14 +119,19 @@ DRIFT_TOL_ENV = "BLUEFOG_MEMORY_DRIFT_TOL"
 FILE_ENV = "BLUEFOG_MEMORY_FILE"
 
 # Owner categories of the live-buffer census, in ranking-tiebreak
-# order. "residuals" covers the CHOCO error-feedback copies, "delay"
-# the delayed-combine double buffers, "windows" every win_create
-# buffer (value + neighbor slots + p lanes), "wire_temp" is reserved
-# for the XLA temporary accounting (BENCH_MODE=memory reads it from
-# the compiled program, not from live arrays), "other" is everything
-# unattributed — batches, user state, framework internals.
+# order. "grads" covers the gradient buffers the optimizer layer holds
+# across a dispatch (the input gradient tree plus the K>1 accumulator
+# — full-width replicated, or the 1/N scattered slots under
+# BLUEFOG_SHARD_GRADS=1, so the ZeRO-2 memory claim is visible in the
+# census), "residuals" the CHOCO error-feedback copies (gossip pairs
+# and the per-slot scatter residuals), "delay" the delayed-combine
+# double buffers, "windows" every win_create buffer (value + neighbor
+# slots + p lanes), "wire_temp" is reserved for the XLA temporary
+# accounting (BENCH_MODE=memory reads it from the compiled program,
+# not from live arrays), "other" is everything unattributed — batches,
+# user state, framework internals.
 CATEGORIES = (
-    "params", "opt_state", "residuals", "delay", "windows",
+    "params", "opt_state", "grads", "residuals", "delay", "windows",
     "wire_temp", "other",
 )
 
@@ -417,7 +422,7 @@ class MemoryObservatory:
     # -- observation ----------------------------------------------------------
 
     def observe(self, ctx, *, step: int, optimizer=None, params=None,
-                opt_state=None) -> Optional[dict]:
+                opt_state=None, grads=None) -> Optional[dict]:
         """Called once per communicating step. Unsampled steps cost one
         compare + one increment; the sampled step walks the live-array
         census and reconciles it against the analytic models."""
@@ -432,22 +437,32 @@ class MemoryObservatory:
             return None
         return self._sample(
             ctx, step=step, optimizer=optimizer, params=params,
-            opt_state=opt_state,
+            opt_state=opt_state, grads=grads,
         )
 
-    def _owner_trees(self, ctx, optimizer, params, opt_state) -> Dict:
+    def _owner_trees(self, ctx, optimizer, params, opt_state,
+                     grads=None) -> Dict:
         owners: Dict[str, Any] = {}
         if params is not None:
             owners["params"] = params
         if opt_state is not None:
             owners["opt_state"] = opt_state
+        grad_trees = []
+        if grads is not None:
+            grad_trees.append(grads)
         if optimizer is not None:
             ef = getattr(optimizer, "_ef", None)
-            if ef:
-                owners["residuals"] = ef
+            scatter_ef = getattr(optimizer, "_scatter_ef", None)
+            if ef or scatter_ef:
+                owners["residuals"] = (ef or (), scatter_ef or ())
             buf = getattr(optimizer, "_delay_buf", None)
             if buf:
                 owners["delay"] = buf
+            accum = getattr(optimizer, "_grad_accum", None)
+            if accum is not None:
+                grad_trees.append(accum)
+        if grad_trees:
+            owners["grads"] = grad_trees
         wins = getattr(ctx, "windows", None)
         if wins:
             owners["windows"] = [
@@ -492,12 +507,14 @@ class MemoryObservatory:
             return None
 
     def _sample(self, ctx, *, step, optimizer, params,
-                opt_state) -> dict:
+                opt_state, grads=None) -> dict:
         from bluefog_tpu import flight as flight_mod
         from bluefog_tpu import metrics as metrics_mod
 
         self._tick_mutes()
-        owners = self._owner_trees(ctx, optimizer, params, opt_state)
+        owners = self._owner_trees(
+            ctx, optimizer, params, opt_state, grads=grads
+        )
         c = census(owners)
         self.last_census = c
         total = float(sum(rec["bytes"] for rec in c.values()))
@@ -787,7 +804,7 @@ def active() -> Optional[MemoryObservatory]:
 
 
 def observe_step(ctx, *, step: int, optimizer=None, params=None,
-                 opt_state=None) -> None:
+                 opt_state=None, grads=None) -> None:
     """Optimizer-layer hook, called after every communicating dispatch
     (next to the doctor / health / staleness hooks). No-op (one
     attribute read) when no session is active."""
@@ -795,7 +812,7 @@ def observe_step(ctx, *, step: int, optimizer=None, params=None,
     if obs is None:
         return
     obs.observe(ctx, step=step, optimizer=optimizer, params=params,
-                opt_state=opt_state)
+                opt_state=opt_state, grads=grads)
 
 
 def on_oom(reason: str, message: str = "") -> List[dict]:

@@ -247,7 +247,7 @@ def _shard_own_slices(tree, layout, axis):
     return tuple(out)
 
 
-def _sharded_inner_update(tx, layout, p, s, g):
+def _sharded_inner_update(tx, layout, p, s, g, own_g=None):
     """The ZeRO-1 weight update (arxiv 2004.13336), valid exactly when
     the update inputs are rank-invariant (the gradient-allreduce
     family): each rank updates only its owned slot of the packed
@@ -256,11 +256,17 @@ def _sharded_inner_update(tx, layout, p, s, g):
     updated slices and the full tree is repacked. Runs inside the
     shard_map block on UNSTACKED trees; ``s`` is a
     :class:`bluefog_tpu.sharding.ShardedOptState`. Returns ``(p, s)``.
+
+    ``own_g`` short-circuits the gradient slicing for the ZeRO-2 form:
+    the reduce-scatter already delivered each rank its owned slot of
+    the fleet-mean gradient, so the full-width gradient is never
+    materialized here (:func:`_scatter_own_grads`).
     """
     _shard_check_groups(p, layout, "parameter")
-    _shard_check_groups(g, layout, "gradient")
+    if own_g is None:
+        _shard_check_groups(g, layout, "gradient")
+        own_g = _shard_own_slices(g, layout, ctx_mod.WORKER_AXIS)
     own_p = _shard_own_slices(p, layout, ctx_mod.WORKER_AXIS)
-    own_g = _shard_own_slices(g, layout, ctx_mod.WORKER_AXIS)
     if layout.master:
         # fp32 master slices carry the reference values; the update
         # runs in fp32 and the wire ships the narrowed result
@@ -287,9 +293,50 @@ def _sharded_inner_update(tx, layout, p, s, g):
     return _unpack_groups(p, tuple(full)), s_out
 
 
+def _scatter_own_grads(g, layout, wire, chunks, ef_blocks):
+    """The ZeRO-2 gradient leg (arxiv 2004.13336's full
+    weight-update-sharding form): ring reduce-scatter every packed
+    dtype group so each rank receives ONLY its owned 512-aligned slot
+    of the fleet-mean gradient — the full-width allreduce output is
+    never materialized. The scatter speaks the same wire tiers as the
+    gossip path (``wire``); the ``*_ef`` tiers hold their CHOCO
+    residual per-slot in ``ef_blocks`` (one ``[padded]`` f32 per
+    group). Reduction order is fixed inside
+    :func:`bluefog_tpu.collective.inner.reduce_scatter` (own row
+    first, then ring rounds in order), which is what keeps the
+    sharded==replicated trajectory pins inside their envelopes.
+    Returns ``(own_g, ef_blocks')`` — ``ef_blocks'`` is ``()`` for the
+    residual-free tiers."""
+    _shard_check_groups(g, layout, "gradient")
+    packs = _pack_groups(g)
+    live_index = tuple(int(v) for v in layout.live_index())
+    live_set = set(layout.live)
+    live_mask = tuple(
+        1.0 if r in live_set else 0.0 for r in range(layout.size)
+    )
+    own, ef_out = [], []
+    for gi, gsh in enumerate(layout.groups):
+        f = jnp.pad(packs[gi], (0, gsh.padded - packs[gi].shape[0]))
+        k = chunks[gi] if gi < len(chunks) else 1
+        if wire in ("int8_ef", "int4_ef"):
+            y, e_new = inner.reduce_scatter(
+                f, ctx_mod.WORKER_AXIS, live_index, gsh.slot,
+                average=True, wire=wire, chunks=k,
+                ef=ef_blocks[gi], live_mask=live_mask,
+            )
+            ef_out.append(e_new)
+        else:
+            y = inner.reduce_scatter(
+                f, ctx_mod.WORKER_AXIS, live_index, gsh.slot,
+                average=True, wire=wire, chunks=k,
+            )
+        own.append(y)
+    return tuple(own), tuple(ef_out)
+
+
 def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
                     ef, ef_state, p, s, g, wire=None, with_metrics=False,
-                    shard=None):
+                    shard=None, scatter_wire=None, scatter_chunks=()):
     """The gossip+inner-update core shared by :meth:`_GossipOptimizer.step`
     and the fused builder (:meth:`_GossipOptimizer.make_train_step`).
 
@@ -350,6 +397,19 @@ def _combine_update(order, tx, gossip_fn, wops, step, cap_bytes,
         # wire IS the local gradient: disagreement = ||g_avg - g_local||
         if with_metrics:
             mvec = probe(g, ef_state, allreduce_fn)
+        if shard is not None and shard.grads:
+            # BLUEFOG_SHARD_GRADS=1 (ZeRO-2): lower the gradient
+            # allreduce to reduce-scatter(own slot) — each rank
+            # receives only the 1/N slot its update consumes, and the
+            # ef_state slot carries the scatter wire's per-slot
+            # residuals (not the gossip CHOCO copies)
+            own_g, ef_state = _scatter_own_grads(
+                g, shard, scatter_wire, scatter_chunks, ef_state
+            )
+            p, s = _sharded_inner_update(
+                tx, shard, p, s, g, own_g=own_g
+            )
+            return p, s, ef_state, mvec
         g = _packed_gossip(g, allreduce_fn, step, wops, cap_bytes)
 
     if shard is not None:
@@ -634,6 +694,7 @@ class _GossipOptimizer:
         token = ctx.live_token()
         groups = self._shard_groups(params)
         master = sharding.master_enabled()
+        grads = sharding.grads_enabled()
         lay = self._shard_layout
         if (
             lay is not None
@@ -641,10 +702,23 @@ class _GossipOptimizer:
             and lay.master == master
             and tuple((g.dtype, g.elems) for g in lay.groups) == groups
         ):
+            if lay.grads != grads:
+                # a pure BLUEFOG_SHARD_GRADS flip swaps the gradient
+                # leg (allreduce <-> reduce-scatter), not the state
+                # layout: rebuild so the layout signature (and thus
+                # the compiled-step cache key) changes, but do NOT
+                # report a membership change — the slot map is
+                # identical and there is nothing to re-shard
+                lay = sharding.build_layout(
+                    groups, lay.live, ctx.size, master=master,
+                    token=token, grads=grads,
+                )
+                self._shard_layout = lay
             return lay, False
         live = token[1] if token is not None else tuple(range(ctx.size))
         new = sharding.build_layout(
-            groups, live, ctx.size, master=master, token=token
+            groups, live, ctx.size, master=master, token=token,
+            grads=grads,
         )
         changed = lay is not None
         self._shard_layout = new
@@ -809,6 +883,85 @@ class _GossipOptimizer:
             opt_state = self._reshard_state(ctx, old, layout, opt_state)
             self._register_shard(layout, opt_state)
         return layout, opt_state
+
+    def _scatter_active(self) -> bool:
+        """ZeRO-2 (``BLUEFOG_SHARD_GRADS=1``) on top of an active shard
+        family: the gradient leg lowers to reduce-scatter, so the
+        gossip-path error-feedback state (full-width CHOCO copies) must
+        not engage — the scatter leg holds its own per-slot residuals
+        (:meth:`_ensure_scatter_ef`)."""
+        return self._shard_active() and sharding.grads_enabled()
+
+    def _scatter_chunks(self, ctx, layout):
+        """Per-group transfer chunk counts for the reduce-scatter leg,
+        chosen by the same calibrated alpha-beta model as the gossip
+        plans — priced on the per-round SLOT payload (the scatter ships
+        one slot per round, and a quantized wire ships fewer bytes per
+        element than the storage dtype, cf. :meth:`_plan_chunks`)."""
+        from bluefog_tpu import scaling
+
+        out = []
+        for g in layout.groups:
+            itemsize = np.dtype(g.dtype).itemsize
+            payload = (
+                scaling.wire_payload_bytes(
+                    g.slot, itemsize, self.compression
+                )
+                if self.compression is not None
+                else g.slot * itemsize
+            )
+            out.append(compiler.reduce_scatter_chunks(
+                ctx.size, payload, n_elems=g.slot
+            ))
+        return tuple(out)
+
+    def _ensure_scatter_ef(self, ctx, layout, spec):
+        """Per-group per-slot CHOCO residuals for the ZeRO-2 scatter
+        wire's ``*_ef`` tiers: worker-stacked ``[size, padded]`` f32,
+        rebuilt (zeroed) whenever the layout signature or the wire tier
+        changes — a re-shard moves slot ownership, so stale residuals
+        would integrate against the wrong coordinates, while zeroed
+        ones merely re-transmit full magnitude for a few steps (same
+        reset discipline as :meth:`_ensure_ef_state`)."""
+        from jax.sharding import NamedSharding
+
+        sig = (layout.sig(), self.compression)
+        if getattr(self, "_scatter_ef_sig", None) == sig:
+            return
+        nd = NamedSharding(ctx.mesh, spec)
+        self._scatter_ef = tuple(
+            jax.device_put(
+                np.zeros((ctx.size, g.padded), np.float32), nd
+            )
+            for g in layout.groups
+        )
+        self._scatter_ef_sig = sig
+
+    def _scatter_prologue(self, ctx, shard_l, spec):
+        """The ZeRO-2 dispatch prologue shared by :meth:`step` and the
+        fused builder: resolve the scatter wire/chunks, materialize the
+        per-slot EF residuals when the tier needs them, and build the
+        cache-key appendix that keeps wire/chunk/kernel flips from
+        aliasing compiled programs. Returns ``(scatter_key,
+        scatter_wire, scatter_chunks, scatter_ef)`` — all empty/None
+        when the layout does not shard gradients."""
+        if shard_l is None or not shard_l.grads:
+            return (), None, (), False
+        scatter_wire = self.compression
+        scatter_chunks = self._scatter_chunks(ctx, shard_l)
+        scatter_ef = scatter_wire in ("int8_ef", "int4_ef")
+        if scatter_ef:
+            self._ensure_scatter_ef(ctx, shard_l, spec)
+        # kernel token only for the kernel-gated tiers: the EF scatter
+        # quantizes through the composite pair unconditionally (see
+        # inner.reduce_scatter), so a kernel flip cannot change it
+        scatter_key = (
+            "scatter", scatter_wire or "fp32", scatter_chunks,
+        ) + (
+            inner._kernels.cache_token(scatter_wire)
+            if scatter_wire in ("int8", "int4") else ()
+        )
+        return scatter_key, scatter_wire, scatter_chunks, scatter_ef
 
     # -- gossip resolution ---------------------------------------------------
 
@@ -1048,6 +1201,17 @@ class _GossipOptimizer:
                 "compression must be None, 'int8', 'bf16', 'int4', "
                 f"'int8_ef', or 'int4_ef', got {self.compression!r}"
             )
+        if (
+            comm == CommunicationType.allreduce
+            and self.order == "grad"
+            and self.schedule is None
+            and sharding.enabled()
+            and sharding.grads_enabled()
+        ):
+            # ZeRO-2 scatter wire: every tier rides the reduce-scatter
+            # gradient leg (the *_ef residuals are held per-slot inside
+            # the scatter, not as gossip CHOCO copies)
+            return
         if self.compression in ("int8_ef", "int4_ef") and (
             comm != CommunicationType.neighbor_allreduce
             or self.schedule is not None
@@ -1241,7 +1405,7 @@ class _GossipOptimizer:
             )
         ef = comm_now and not hier and self.compression in (
             "int8_ef", "int4_ef",
-        )
+        ) and not self._scatter_active()
         if ef:
             self._ensure_ef_state(ctx, params, spec, gossip_key[2])
         return (
@@ -1262,6 +1426,14 @@ class _GossipOptimizer:
         if self.compression in (
             "int8", "bf16", "int8_ef", "int4", "int4_ef",
         ):
+            if (
+                self.compression.endswith("_ef")
+                and self._scatter_active()
+            ):
+                # ZeRO-2 scatter EF: the residual lives per-slot inside
+                # the scatter (no probe-side CHOCO slice), so the metric
+                # row replays the base tier's quantization error
+                return self.compression[:-3]
             return self.compression
         return None
 
@@ -1340,12 +1512,30 @@ class _GossipOptimizer:
                 n = int(np.prod(l.shape[1:])) if l.ndim > 1 else 1
                 item = np.dtype(l.dtype).itemsize
                 by_item[item] = by_item.get(item, 0) + n
+            scatter_bytes = 0
             if tag == "allreduce":
-                # ring allreduce ships ~2 (n-1)/n payloads per worker
-                payload = sum(i * n for i, n in by_item.items())
-                wire_bytes = int(
-                    2 * (ctx.size - 1) / max(ctx.size, 1) * payload
-                )
+                if shard is not None and shard.grads:
+                    # ZeRO-2: the gradient leg is a reduce-scatter of
+                    # owned slots (optionally quantized) — price what
+                    # actually ships, not the allreduce formula the
+                    # replicated family would have used
+                    from bluefog_tpu import scaling
+
+                    scatter_bytes = scaling.reduce_scatter_bytes(
+                        tuple(
+                            (g.slot, np.dtype(g.dtype).itemsize)
+                            for g in shard.groups
+                        ),
+                        shard.size, wire=self.compression,
+                    )
+                    wire_bytes = scatter_bytes
+                    rounds = shard.size - 1
+                else:
+                    # ring allreduce ships ~2 (n-1)/n payloads per worker
+                    payload = sum(i * n for i, n in by_item.items())
+                    wire_bytes = int(
+                        2 * (ctx.size - 1) / max(ctx.size, 1) * payload
+                    )
             else:
                 wire_bytes = metrics_mod.wire_bytes_per_step(
                     by_item, rounds, wire
@@ -1354,9 +1544,9 @@ class _GossipOptimizer:
                 # the sharded step ships the updated slices back over
                 # the fabric: price the all-gather with the gossip wire
                 wire_bytes += sharding.gather_wire_bytes(shard)
-            acct = (rounds, wire_bytes)
+            acct = (rounds, wire_bytes, scatter_bytes)
             self._acct_cache[key] = acct
-        rounds, wire_bytes = acct
+        rounds, wire_bytes, scatter_bytes = acct
         metrics_mod.gauge("bluefog.gossip.rounds").set(rounds)
         metrics_mod.counter("bluefog.wire_bytes").inc(wire_bytes)
         metrics_mod.counter("bluefog.comm_steps").inc()
@@ -1372,6 +1562,16 @@ class _GossipOptimizer:
             metrics_mod.counter("bluefog.shard.gather_bytes").inc(
                 sharding.gather_wire_bytes(shard)
             )
+            metrics_mod.gauge("bluefog.shard.grads").set(
+                1 if shard.grads else 0
+            )
+            if shard.grads:
+                metrics_mod.counter("bluefog.shard.scatter_bytes").inc(
+                    scatter_bytes
+                )
+                metrics_mod.gauge("bluefog.shard.grad_bytes").set(
+                    sharding.grad_bytes(shard)
+                )
 
     def step(self, params, opt_state, grads):
         """One decentralized optimization step; returns (params, opt_state).
@@ -1397,6 +1597,9 @@ class _GossipOptimizer:
         shard_l = None
         if comm_now and self._shard_active():
             shard_l, opt_state = self._shard_prepare(ctx, params, opt_state)
+        (
+            scatter_key, scatter_wire, scatter_chunks, scatter_ef,
+        ) = self._scatter_prologue(ctx, shard_l, spec)
         met_enabled = metrics_mod.enabled() and comm_now
         # Two-program sampling: only the 1-in-interval sampled step pays
         # the metric computation — every other step dispatches a program
@@ -1416,7 +1619,7 @@ class _GossipOptimizer:
             # pin); an active layout keys on its full signature so a
             # membership change can never dispatch a stale owner map
             shard_l.sig() if shard_l is not None else ()
-        ) + _aval_key(params)
+        ) + scatter_key + _aval_key(params)
         fn = ctx.op_cache.get(key)
         if fn is None:
             metrics_mod.counter("bluefog.recompiles").inc()
@@ -1429,15 +1632,17 @@ class _GossipOptimizer:
                 s = _tree_block(state_b)
                 g = _tree_block(grads_b)
                 step = step[0]
-                ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
+                # unstack whichever EF state rides this program: the
+                # gossip CHOCO pairs or the ZeRO-2 per-slot residuals
+                ef_in = jax.tree_util.tree_map(lambda a: a[0], ef_b)
                 p, s, ef_out, mvec = _combine_update(
                     order, tx, gossip_fn, wops, step, cap_bytes,
                     ef, ef_in, p, s, g, wire=wire_now, with_metrics=met,
-                    shard=shard_l,
+                    shard=shard_l, scatter_wire=scatter_wire,
+                    scatter_chunks=scatter_chunks,
                 )
-                ef_out = tuple(
-                    (jnp.expand_dims(sb, 0), jnp.expand_dims(rb, 0))
-                    for sb, rb in ef_out
+                ef_out = jax.tree_util.tree_map(
+                    lambda a: jnp.expand_dims(a, 0), ef_out
                 )
                 met_out = (
                     (_tree_restack(mvec),) if met else ()
@@ -1467,7 +1672,10 @@ class _GossipOptimizer:
         self._step_count += 1
         if comm_now:
             self._comm_count += 1
-        ef_in = self._ef if ef else ()
+        if scatter_ef:
+            ef_in = self._scatter_ef
+        else:
+            ef_in = self._ef if ef else ()
         if met_enabled:
             self._record_comm_accounting(
                 key, gossip_key, params, ctx, shard=shard_l
@@ -1515,10 +1723,12 @@ class _GossipOptimizer:
             # is untouched (same cache key, bitwise pin)
             memory_mod.observe_step(
                 ctx, step=self._step_count - 1, optimizer=self,
-                params=params_out, opt_state=opt_state,
+                params=params_out, opt_state=opt_state, grads=grads,
             )
         if ef:
             self._ef = ef_out
+        elif scatter_ef:
+            self._scatter_ef = ef_out
         if met:
             self._drain_after_sample(wire_now, met_out[0])
         return params_out, opt_state
@@ -1634,6 +1844,9 @@ class _GossipOptimizer:
                 shard_l, opt_state = self._shard_prepare(
                     ctx, params, opt_state
                 )
+            (
+                scatter_key, scatter_wire, scatter_chunks, scatter_ef,
+            ) = self._scatter_prologue(ctx, shard_l, spec)
             if delayed and hier:
                 raise ValueError(
                     "delayed=True is not supported for hierarchical "
@@ -1667,7 +1880,7 @@ class _GossipOptimizer:
                 # same shard-key discipline as step(): absent when off
                 # (bitwise pin), full layout signature when on
                 shard_l.sig() if shard_l is not None else ()
-            ) + _aval_key((params, opt_state, batch))
+            ) + scatter_key + _aval_key((params, opt_state, batch))
             fn = ctx.op_cache.get(key)
             if fn is None:
                 metrics_mod.counter("bluefog.recompiles").inc()
@@ -1777,17 +1990,21 @@ class _GossipOptimizer:
                         )
                         ef_out = ()
                     else:
-                        ef_in = tuple((sb[0], rb[0]) for sb, rb in ef_b)
+                        # unstack whichever EF state rides this
+                        # program: gossip CHOCO pairs or the ZeRO-2
+                        # per-slot scatter residuals
+                        ef_in = jax.tree_util.tree_map(
+                            lambda a: a[0], ef_b
+                        )
                         p, s, ef_out, mvec = _combine_update(
                             order, tx, gossip_fn, wops, step, cap_bytes,
                             ef, ef_in, p, s, grads,
                             wire=wire_now, with_metrics=met,
-                            shard=shard_l,
+                            shard=shard_l, scatter_wire=scatter_wire,
+                            scatter_chunks=scatter_chunks,
                         )
-                        ef_out = tuple(
-                            (jnp.expand_dims(sb, 0),
-                             jnp.expand_dims(rb, 0))
-                            for sb, rb in ef_out
+                        ef_out = jax.tree_util.tree_map(
+                            lambda a: jnp.expand_dims(a, 0), ef_out
                         )
                         buf_out = ()
                     met_out = (
@@ -1829,7 +2046,10 @@ class _GossipOptimizer:
             self._step_count += 1
             if comm_now:
                 self._comm_count += 1
-            ef_in = self._ef if ef else ()
+            if scatter_ef:
+                ef_in = self._scatter_ef
+            else:
+                ef_in = self._ef if ef else ()
             buf_in = self._delay_buf if delay_now else ()
             accum_in = accum if accum is not None else ()
             if met_enabled:
@@ -1870,6 +2090,8 @@ class _GossipOptimizer:
                 )
                 if ef:
                     self._ef = ef_o
+                elif scatter_ef:
+                    self._scatter_ef = ef_o
                 if delay_now:
                     self._delay_buf = buf_o
                 if comm_now and self.order == "grad":

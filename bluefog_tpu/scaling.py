@@ -64,6 +64,8 @@ __all__ = [
     "optimizer_state_bytes",
     "LINEAGE_TAG_BYTES",
     "ring_allreduce_cost",
+    "reduce_scatter_bytes",
+    "ring_reduce_scatter_cost",
     "one_peer_gossip_cost",
     "weak_scaling_times",
     "ROUND_ALPHA_S",
@@ -493,6 +495,36 @@ def one_peer_gossip_cost(payload_bytes: int) -> Dict[str, float]:
     """Analytical dynamic one-peer gossip cost: one hop, one payload,
     independent of N (reference ``README.rst:51-60`` row 'Bluefog')."""
     return {"latency_hops": 1, "wire_bytes": float(payload_bytes)}
+
+
+def reduce_scatter_bytes(groups, n: int,
+                         wire: Optional[str] = None) -> int:
+    """Per-rank wire bytes of one ZeRO-2 reduce-scatter gradient leg:
+    ``N-1`` ring rounds each shipping ONE owned slot per dtype group,
+    priced through :func:`wire_payload_bytes` so the quantized tiers
+    (scale sidecar included) and the evidence artifacts agree with
+    what the metrics counter records. ``groups`` is ``[(slot_elems,
+    itemsize)]`` — the shard layout's slot grid. This is the byte model
+    ``bluefog.wire_bytes`` routes through when ``BLUEFOG_SHARD_GRADS=1``
+    replaces the gradient allreduce (which would ship ``~2 (N-1)/N``
+    FULL payloads instead of ``N-1`` slots ≈ one payload)."""
+    return sum(
+        (max(int(n), 1) - 1) * wire_payload_bytes(slot, itemsize, wire)
+        for slot, itemsize in groups
+    )
+
+
+def ring_reduce_scatter_cost(n: int, slot_bytes: int) -> Dict[str, float]:
+    """Analytical ring reduce-scatter per-device cost (the ZeRO-2
+    gradient leg, arxiv 2004.13336): ``N-1`` sequential hops each
+    moving one owned slot — with ``slot = payload/N`` this is
+    ``(N-1)/N`` of the payload, HALF of :func:`ring_allreduce_cost`'s
+    wire at the same width, and the scatter+gather pair together match
+    one allreduce."""
+    return {
+        "latency_hops": n - 1,
+        "wire_bytes": float((n - 1) * slot_bytes),
+    }
 
 
 def weak_scaling_times(

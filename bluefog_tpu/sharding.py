@@ -65,6 +65,7 @@ __all__ = [
     "ALIGN_ELEMS",
     "enabled",
     "master_enabled",
+    "grads_enabled",
     "GroupShard",
     "ShardLayout",
     "build_layout",
@@ -73,6 +74,9 @@ __all__ = [
     "ShardedOptState",
     "state_bytes",
     "gather_wire_bytes",
+    "scatter_wire_bytes",
+    "allreduce_wire_bytes",
+    "grad_bytes",
     "register_active",
     "clear_active",
     "summary",
@@ -101,6 +105,16 @@ def master_enabled() -> bool:
     return os.environ.get("BLUEFOG_SHARD_MASTER", "0") == "1"
 
 
+def grads_enabled() -> bool:
+    """``BLUEFOG_SHARD_GRADS=1`` (under ``BLUEFOG_SHARD=1``) lowers the
+    gradient leg from full-width allreduce to reduce-scatter (ZeRO-2,
+    the full weight-update-sharding formulation of arxiv 2004.13336):
+    each rank receives only its owned 512-aligned slot of the reduced
+    gradient, cutting peak gradient memory to ~1/N and the gradient
+    wire to ~half of allreduce. Ignored without ``BLUEFOG_SHARD=1``."""
+    return os.environ.get("BLUEFOG_SHARD_GRADS", "0") == "1"
+
+
 class GroupShard(NamedTuple):
     """One dtype group's shard geometry."""
 
@@ -118,11 +132,16 @@ class ShardLayout(NamedTuple):
     size: int                   # mesh size (rows of worker-stacked trees)
     master: bool
     token: Any                  # ctx.live_token() at build (None = all live)
+    grads: bool = False         # ZeRO-2: gradient leg is reduce-scatter
 
     def sig(self) -> tuple:
         """Hashable cache-key component: everything that changes the
-        compiled sharded program or the state it runs on."""
-        return ("shard", self.live, self.master, tuple(self.groups))
+        compiled sharded program or the state it runs on. The ZeRO-1
+        tuple is kept VERBATIM when gradient sharding is off — the
+        PR-14 cache keys must not move under a pure library upgrade —
+        and gains a trailing marker when the scatter lowering is on."""
+        base = ("shard", self.live, self.master, tuple(self.groups))
+        return base + (("grads",) if self.grads else ())
 
     def live_index(self) -> np.ndarray:
         """int32 ``[size]``: rank -> its owner index among the live set
@@ -173,6 +192,7 @@ def build_layout(
     size: int,
     master: bool = False,
     token: Any = None,
+    grads: bool = False,
 ) -> ShardLayout:
     """Build the shard layout for ``groups`` = [(dtype_name, elems)] in
     packed-wire order over the ``live`` ranks of a ``size`` mesh."""
@@ -206,7 +226,8 @@ def build_layout(
             slot += ALIGN_ELEMS
         used.add(slot)
         shards.append(GroupShard(str(dt), d, slot, slot * n))
-    return ShardLayout(tuple(shards), live, int(size), bool(master), token)
+    return ShardLayout(tuple(shards), live, int(size), bool(master), token,
+                       bool(grads))
 
 
 # -- host-side slice algebra (reshard / checkpoint gather) -------------------
@@ -298,6 +319,43 @@ def gather_wire_bytes(layout: ShardLayout, live_only: bool = False) -> int:
     return sum((n - 1) * g.slot * _itemsize(g.dtype) for g in layout.groups)
 
 
+def scatter_wire_bytes(layout: ShardLayout, live_only: bool = False) -> int:
+    """Per-rank gradient wire of one ZeRO-2 step: the ring
+    reduce-scatter ships one slot to every *other* rank — ``(size-1) *
+    slot`` per group at the exact (fp32) tier, the mirror image of the
+    redistribution all-gather. ``live_only=True`` prices the ideal
+    live-set-restricted ring. Quantized scatter tiers price through
+    ``scaling.wire_payload_bytes`` on the slot width (the accounting
+    the optimizer layer records)."""
+    n = len(layout.live) if live_only else layout.size
+    return sum((n - 1) * g.slot * _itemsize(g.dtype) for g in layout.groups)
+
+
+def allreduce_wire_bytes(layout: ShardLayout) -> int:
+    """Per-rank gradient wire of the ZeRO-1 baseline the scatter
+    replaces: a bandwidth-optimal ring allreduce on the full packed
+    width ships ``2*(size-1)/size * elems`` per group
+    (``scaling.ring_allreduce_cost``)."""
+    n = layout.size
+    return sum(
+        int(2 * (n - 1) / max(n, 1) * g.elems * _itemsize(g.dtype))
+        for g in layout.groups
+    )
+
+
+def grad_bytes(layout: ShardLayout, sharded: bool = True) -> int:
+    """Peak per-rank reduced-gradient bytes: the owned slot under
+    ZeRO-2 (``sharded=True``) vs the full packed group under the
+    allreduce baseline. This is the ×1/N gradient-memory claim the
+    memory observatory's census measures against (the backward pass's
+    full-width gradient still exists upstream of the scatter; what
+    shrinks is the *reduced* gradient the update consumes)."""
+    return sum(
+        (g.slot if sharded else g.elems) * _itemsize(g.dtype)
+        for g in layout.groups
+    )
+
+
 # -- observability registry --------------------------------------------------
 
 # The most recent active layout + counters, published by the optimizer
@@ -324,6 +382,12 @@ def register_active(layout: ShardLayout, slots_per_param: int = 2,
         "state_bytes_replicated": state_bytes(layout, slots_per_param,
                                               False),
         "gather_bytes_per_step": gather_wire_bytes(layout),
+        "grads": layout.grads,
+        "scatter_bytes_per_step": (
+            scatter_wire_bytes(layout) if layout.grads else 0
+        ),
+        "grad_bytes_sharded": grad_bytes(layout, True),
+        "grad_bytes_replicated": grad_bytes(layout, False),
         "reshards": reshards,
     })
     if measured_state_bytes is not None:

@@ -971,10 +971,14 @@ def _check_shard(lines):
     1/N (+ the disclosed 512-alignment slack) on an 8-worker mesh, for
     a model whose REPLICATED state exceeds the simulated per-chip
     budget the sharded run trains under; the sharded trajectory
-    matching both the replicated path and the numpy Adam oracle; step
-    time within the disclosed A/A noise floor of unsharded; and the
-    BLUEFOG_SHARD=0 bitwise pin with zero shard-tagged cache keys —
-    plus provenance and the ambient anchor."""
+    matching both the replicated path and the numpy Adam oracle (and
+    the ZeRO-2 reduce-scatter run inside the SAME envelope); step
+    time within the disclosed A/A noise floor of unsharded; the
+    BLUEFOG_SHARD=0 bitwise pin with zero shard-tagged cache keys; and
+    the ZeRO-2 gradient-wire row (measured reduced-gradient bytes at
+    ~1/N with disclosed pad slack, scatter+gather <= allreduce+gather,
+    per-tier scatter wire at the exact block-scale ratios) — plus
+    provenance and the ambient anchor."""
     _assert_provenance(lines)
     mem = [l for l in lines if l.get("metric") == "shard_memory"]
     assert mem, lines
@@ -999,6 +1003,11 @@ def _check_shard(lines):
     assert traj[0]["sharded_matches_replicated"] is True
     assert traj[0]["sharded_matches_numpy_oracle"] is True
     assert traj[0]["traj_max_dev"] <= traj[0]["tol"]
+    # ZeRO-2 (reduce-scatter gradient leg) sits inside the SAME pin
+    # envelope — the scatter changed the wire, not the trajectory
+    assert traj[0]["zero2_matches_replicated"] is True
+    assert traj[0]["zero2_matches_numpy_oracle"] is True
+    assert traj[0]["zero2_max_dev"] <= traj[0]["tol"]
     t = [l for l in lines if l.get("metric") == "shard_step_time"]
     assert t, lines
     assert t[0]["within_noise"] is True
@@ -1008,6 +1017,35 @@ def _check_shard(lines):
     assert off, lines
     assert off[0]["bitwise_identical"] is True
     assert off[0]["shard_tagged_cache_keys"] == 0
+    gw = [l for l in lines if l.get("metric") == "shard_grad_wire"]
+    assert gw, lines
+    g = gw[0]
+    # measured reduced-gradient footprint is exactly slot/dim of
+    # replicated (both real f32 buffers); the ratio is ~1/N plus the
+    # DISCLOSED pad slack
+    assert g["grad_bytes_sharded_measured"] * g["dim"] == (
+        g["grad_bytes_replicated_measured"] * g["slot_elems"]
+    ), g
+    assert g["grad_ratio_measured"] <= (
+        1.0 / g["workers"] + g["grad_pad_ratio"] + 1e-6
+    ), g
+    assert g["grad_pad_ratio"] >= 0
+    # the wire claim: the ZeRO-2 leg never ships more than the baseline
+    assert g["wire_le_baseline"] is True
+    assert g["scatter_plus_gather"] <= g["allreduce_plus_gather"], g
+    assert g["scatter_bytes_per_step"] < g["allreduce_bytes_per_step"], g
+    # quantized scatter tiers at the EXACT block-scale ratios (slots
+    # are 512-grid multiples, so 516/2048 and 258/2048 are exact)
+    tiers = g["tiers"]
+    assert tiers["int8"]["ratio_vs_fp32"] == round(516 / 2048, 6), g
+    assert tiers["int4"]["ratio_vs_fp32"] == round(258 / 2048, 6), g
+    assert tiers["int8_ef"]["ratio_vs_fp32"] == (
+        tiers["int8"]["ratio_vs_fp32"]
+    ), g
+    assert tiers["int4_ef"]["ratio_vs_fp32"] == (
+        tiers["int4"]["ratio_vs_fp32"]
+    ), g
+    assert tiers["bf16"]["ratio_vs_fp32"] == 0.5, g
     anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
     assert anchor and anchor[0]["tflops"] > 0
 

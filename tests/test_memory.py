@@ -179,6 +179,23 @@ def test_census_classifies_owner_categories():
     assert ranked[0]["bytes"] >= ranked[-1]["bytes"]
 
 
+def test_census_grads_owner_category():
+    """The ``grads`` owner class (the ZeRO-2 memory axis): a gradient
+    tree handed to the census is attributed to ``grads``, not
+    ``other`` — what the ×1/N reduced-gradient claim is measured
+    against (docs/sharding.md)."""
+    opt, params, state, grads = _adam_problem()
+    params, state = opt.step(params, state, grads)
+    assert "grads" in bf_memory.CATEGORIES
+    c0 = bf_memory.census({"params": params, "opt_state": state})
+    c1 = bf_memory.census(
+        {"params": params, "opt_state": state, "grads": grads}
+    )
+    assert c0["grads"]["bytes"] == 0
+    assert c1["grads"]["bytes"] == SIZE * 4096 * 4
+    assert c1["other"]["bytes"] <= c0["other"]["bytes"]
+
+
 def test_reconciliation_is_exact_for_replicated_adam():
     obs = bf_memory.start(interval=1)
     opt, params, state, grads = _adam_problem()

@@ -1,0 +1,393 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""The ZeRO-2 reduce-scatter primitive (``inner.reduce_scatter``,
+docs/sharding.md): numpy oracles for the ring lowering on full and
+partial live sets, fast-path (``lax.psum_scatter``) vs ring parity,
+chunked == monolithic bitwise across every wire tier, EF residual
+semantics (noise recursion, dead-destination masking), and the plan
+compiler's reduce-scatter family.
+
+The conventions under test are the ShardLayout ones: ``live_index``
+maps every mesh rank to its owner position (dead ranks to 0), slots sit
+on the 512-element quantization grid, and the reduction always sums ALL
+``size`` rows and divides by the FULL mesh size — the exact reduction
+``inner.allreduce`` computes, so the scattered trajectory tracks the
+replicated one across an elastic kill.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bluefog_tpu.collective import compiler, inner
+
+SIZE = 8
+AXIS = "workers"
+SLOT = 512  # one quantization block per slot keeps oracles readable
+
+
+def mesh_1d():
+    return jax.make_mesh((SIZE,), (AXIS,))
+
+
+def run_spmd(fn, *arrays, out_specs=P(AXIS)):
+    m = mesh_1d()
+    wrapped = jax.jit(
+        jax.shard_map(
+            fn, mesh=m,
+            in_specs=tuple(P(AXIS) for _ in arrays),
+            out_specs=out_specs,
+        )
+    )
+    return wrapped(*arrays)
+
+
+def rand(shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(np.float32)
+
+
+def full_live_index():
+    return tuple(range(SIZE))
+
+
+def live_index_for(live):
+    """The ShardLayout convention: live ranks to their position among
+    the (sorted) live set, dead ranks to 0."""
+    pos = {r: j for j, r in enumerate(sorted(live))}
+    return tuple(pos.get(r, 0) for r in range(SIZE))
+
+
+def scatter_oracle(x, live_index, slot, n_live):
+    """Host-side definition: rank r's delivered slot is the mean over
+    ALL mesh rows of the slot at its owner position."""
+    mean = x.mean(axis=0)
+    return np.stack([
+        mean[live_index[r] * slot:(live_index[r] + 1) * slot]
+        for r in range(SIZE)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# ring lowering vs numpy oracle
+
+
+@pytest.mark.parametrize("chunks", [1, 2])
+def test_ring_matches_numpy_oracle_full_live(chunks):
+    x = rand((SIZE, SIZE * SLOT), seed=1)
+    lidx = full_live_index()
+    y = run_spmd(
+        lambda t: inner.reduce_scatter(
+            t[0], AXIS, lidx, SLOT, chunks=chunks, fast=False
+        )[None],
+        x,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y), scatter_oracle(x, lidx, SLOT, SIZE),
+        rtol=0, atol=1e-5,
+    )
+
+
+def test_ring_matches_numpy_oracle_live_subset():
+    """A partial live set: the payload is n_live slots wide, dead
+    ranks still contribute their rows (full-mesh psum semantics), and
+    every live rank receives the slot at its owner position."""
+    live = (0, 2, 5, 7)
+    lidx = live_index_for(live)
+    x = rand((SIZE, len(live) * SLOT), seed=2)
+    y = run_spmd(
+        lambda t: inner.reduce_scatter(
+            t[0], AXIS, lidx, SLOT, fast=False
+        )[None],
+        x,
+    )
+    oracle = scatter_oracle(x, lidx, SLOT, len(live))
+    got = np.asarray(y)
+    for r in live:
+        np.testing.assert_allclose(got[r], oracle[r], rtol=0, atol=1e-5)
+
+
+def test_sum_mode_skips_normalization():
+    x = rand((SIZE, SIZE * SLOT), seed=3)
+    lidx = full_live_index()
+    y = run_spmd(
+        lambda t: inner.reduce_scatter(
+            t[0], AXIS, lidx, SLOT, average=False, fast=False
+        )[None],
+        x,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y),
+        scatter_oracle(x, lidx, SLOT, SIZE) * SIZE,
+        rtol=0, atol=1e-4,
+    )
+
+
+def test_fast_path_matches_ring_within_ulps():
+    """``lax.psum_scatter`` and the ring lowering compute the same
+    reduction over the same 8 addends; their summation ORDERS differ
+    (XLA's tree vs own-first-then-rounds), so parity is ulp-level, not
+    bitwise. The bitwise pin that matters — fast path == the psum the
+    replicated allreduce uses — is the trajectory test's job
+    (tests/test_sharding.py)."""
+    x = rand((SIZE, SIZE * SLOT), seed=4)
+    lidx = full_live_index()
+
+    def go(fast):
+        return np.asarray(run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, lidx, SLOT, fast=fast
+            )[None],
+            x,
+        ))
+
+    a, b = go(True), go(False)
+    assert np.abs(a - b).max() <= 1e-6
+
+
+def test_scatter_concat_equals_allreduce():
+    """The concatenated delivered slots ARE the allreduce mean — the
+    two programs compute the same reduction, ZeRO-2 just never
+    materializes the full width on any one rank."""
+    x = rand((SIZE, SIZE * SLOT), seed=5)
+    lidx = full_live_index()
+    y = np.asarray(run_spmd(
+        lambda t: inner.reduce_scatter(
+            t[0], AXIS, lidx, SLOT, fast=False
+        )[None],
+        x,
+    ))
+    full = np.asarray(run_spmd(
+        lambda t: inner.allreduce(t, AXIS, average=True), x,
+    ))
+    np.testing.assert_allclose(
+        y.reshape(-1), full[0], rtol=0, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# chunked == monolithic, every tier
+
+
+@pytest.mark.parametrize("wire", [None, "bf16", "int8", "int4"])
+def test_chunked_equals_monolithic_bitwise(wire):
+    """Chunking is a transfer schedule, not a math change: every
+    round's received chunks are concatenated back to full slot width
+    before the accumulate, so the summation order — and the bits — are
+    identical."""
+    x = rand((SIZE, SIZE * SLOT), seed=6)
+    lidx = full_live_index()
+
+    def go(chunks):
+        return np.asarray(run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, lidx, SLOT, wire=wire, chunks=chunks,
+                fast=False,
+            )[None],
+            x,
+        ))
+
+    assert np.array_equal(go(1), go(4))
+
+
+@pytest.mark.parametrize("wire", ["int8_ef", "int4_ef"])
+def test_chunked_equals_monolithic_bitwise_ef(wire):
+    x = rand((SIZE, SIZE * SLOT), seed=7)
+    e0 = rand((SIZE, SIZE * SLOT), seed=8) * 0.1
+    lidx = full_live_index()
+
+    def go(chunks):
+        y, e = run_spmd(
+            lambda t, et: tuple(
+                a[None] for a in inner.reduce_scatter(
+                    t[0], AXIS, lidx, SLOT, wire=wire, chunks=chunks,
+                    ef=et[0], fast=False,
+                )
+            ),
+            x, e0,
+            out_specs=(P(AXIS), P(AXIS)),
+        )
+        return np.asarray(y), np.asarray(e)
+
+    y1, e1 = go(1)
+    y4, e4 = go(4)
+    assert np.array_equal(y1, y4)
+    assert np.array_equal(e1, e4)
+
+
+# ---------------------------------------------------------------------------
+# quantized tiers: envelope + EF residual semantics
+
+
+@pytest.mark.parametrize("wire,tol", [("bf16", 2e-2), ("int8", 2e-2),
+                                      ("int4", 2e-1)])
+def test_quantized_tier_envelope(wire, tol):
+    """Block-scaled tiers stay within the per-block quantization
+    envelope of the exact reduction (the own-slot contribution is
+    always exact, so the error budget is (size-1)/size of a block)."""
+    x = rand((SIZE, SIZE * SLOT), seed=9)
+    lidx = full_live_index()
+    y = np.asarray(run_spmd(
+        lambda t: inner.reduce_scatter(
+            t[0], AXIS, lidx, SLOT, wire=wire, fast=False
+        )[None],
+        x,
+    ))
+    exact = scatter_oracle(x, lidx, SLOT, SIZE)
+    assert np.abs(y - exact).max() <= tol
+
+
+def test_ef_residual_telescopes():
+    """The CHOCO noise recursion: feeding the residual back makes the
+    RUNNING MEAN of delivered values converge on the exact reduction —
+    strictly closer after two steps than the memoryless tier ever
+    gets."""
+    x = rand((SIZE, SIZE * SLOT), seed=10)
+    lidx = full_live_index()
+    exact = scatter_oracle(x, lidx, SLOT, SIZE)
+
+    def step(ef):
+        y, e = run_spmd(
+            lambda t, et: tuple(
+                a[None] for a in inner.reduce_scatter(
+                    t[0], AXIS, lidx, SLOT, wire="int4_ef",
+                    ef=et[0], fast=False,
+                )
+            ),
+            x, ef,
+            out_specs=(P(AXIS), P(AXIS)),
+        )
+        return np.asarray(y), np.asarray(e)
+
+    e = np.zeros((SIZE, SIZE * SLOT), np.float32)
+    y1, e = step(e)
+    assert np.abs(e).sum() > 0  # the shipped error landed in the residual
+    y2, _ = step(e)
+    err_mean = np.abs((y1 + y2) / 2 - exact).max()
+    err_memoryless = np.abs(y1 - exact).max()
+    assert err_mean < err_memoryless
+
+
+def test_ef_dead_destination_residual_untouched():
+    """Rows whose destination rank is dead never ship a consumed
+    payload, so their residual must not move — otherwise a later
+    repair would replay stale error. Identity owner map (position ==
+    rank) so the dead rank's slot is unaliased and the mask is directly
+    observable."""
+    dead = 7
+    lidx = full_live_index()
+    lmask = tuple(0.0 if r == dead else 1.0 for r in range(SIZE))
+    x = rand((SIZE, SIZE * SLOT), seed=11)
+    e0 = rand((SIZE, SIZE * SLOT), seed=12) * 0.1
+    _y, e1 = run_spmd(
+        lambda t, et: tuple(
+            a[None] for a in inner.reduce_scatter(
+                t[0], AXIS, lidx, SLOT, wire="int8_ef",
+                ef=et[0], live_mask=lmask, fast=False,
+            )
+        ),
+        x, e0,
+        out_specs=(P(AXIS), P(AXIS)),
+    )
+    e1 = np.asarray(e1)
+    dead_sl = slice(dead * SLOT, (dead + 1) * SLOT)
+    for r in range(SIZE):
+        if r == dead:
+            continue
+        # the slot destined to the dead rank kept its residual bitwise
+        assert np.array_equal(e1[r, dead_sl], e0[r, dead_sl]), r
+        # while live-destined slots did absorb quantization error
+        live_to = (r + 1) % SIZE
+        if live_to == dead:
+            live_to = (r + 2) % SIZE
+        sl = slice(live_to * SLOT, (live_to + 1) * SLOT)
+        assert not np.array_equal(e1[r, sl], e0[r, sl]), r
+
+
+def test_ef_requires_residual_and_validates_shapes():
+    x = jnp.zeros((SIZE * SLOT,), jnp.float32)
+    lidx = full_live_index()
+    with pytest.raises(ValueError, match="needs the per-slot residual"):
+        run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, lidx, SLOT, wire="int8_ef", fast=False
+            )[None],
+            np.zeros((SIZE, SIZE * SLOT), np.float32),
+        )
+    del x
+
+
+def test_payload_must_be_slot_multiple():
+    with pytest.raises(ValueError, match="not a multiple of slot"):
+        run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, full_live_index(), SLOT, fast=False
+            )[None],
+            np.zeros((SIZE, SIZE * SLOT + SIZE), np.float32),
+        )
+
+
+def test_unknown_wire_refused():
+    with pytest.raises(ValueError, match="reduce_scatter wire"):
+        run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, full_live_index(), SLOT, wire="fp8",
+                fast=False,
+            )[None],
+            np.zeros((SIZE, SIZE * SLOT), np.float32),
+        )
+
+
+def test_live_mask_length_validated():
+    with pytest.raises(ValueError, match="live_mask"):
+        run_spmd(
+            lambda t: inner.reduce_scatter(
+                t[0], AXIS, full_live_index(), SLOT,
+                live_mask=(1.0,) * 3, fast=False,
+            )[None],
+            np.zeros((SIZE, SIZE * SLOT), np.float32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# plan-compiler reduce-scatter family
+
+
+def test_compile_reduce_scatter_structure():
+    info = compiler.compile_reduce_scatter(SIZE)
+    assert info.size == SIZE and info.rounds == SIZE - 1
+    assert len(info.perms) == SIZE - 1
+    for t, perm in enumerate(info.perms, start=1):
+        assert perm == tuple((r, (r + t) % SIZE) for r in range(SIZE))
+        # every round is a permutation: each rank sends and receives once
+        assert sorted(s for s, _ in perm) == list(range(SIZE))
+        assert sorted(d for _, d in perm) == list(range(SIZE))
+    assert info.predicted_cost_s > 0
+
+
+def test_compile_reduce_scatter_memoized_and_cleared():
+    a = compiler.compile_reduce_scatter(6)
+    b = compiler.compile_reduce_scatter(6)
+    assert a is b
+    compiler.clear_compile_cache()
+    c = compiler.compile_reduce_scatter(6)
+    assert c is not a and c.perms == a.perms
+
+
+def test_compile_reduce_scatter_rejects_empty_mesh():
+    with pytest.raises(ValueError, match="positive mesh"):
+        compiler.compile_reduce_scatter(0)
+
+
+def test_reduce_scatter_chunks_on_grid():
+    # a big payload splits, a tiny one does not, and chunk edges stay
+    # on the 512-element grain (chunks never exceed elems/512)
+    small = compiler.reduce_scatter_chunks(SIZE, 2048.0, n_elems=512)
+    assert small == 1
+    big = compiler.reduce_scatter_chunks(
+        SIZE, 64 * 1024 * 1024.0, n_elems=16 * 1024 * 1024
+    )
+    assert big >= 1
+    assert big <= (16 * 1024 * 1024) // 512

@@ -79,6 +79,33 @@ def test_allreduce_lowered_to_all_reduce():
     assert ring["wire_bytes"] > gossip["wire_bytes"]
 
 
+def test_reduce_scatter_byte_model():
+    """The ZeRO-2 gradient-leg pricing: (N-1) owned slots per rank at
+    the tier's payload width; the scatter of one slot beats the full-
+    width ring allreduce, and the quantized tiers price the block-scale
+    sidecar exactly (516/2048 and 258/2048 on the 512 grid)."""
+    slot = 37888  # a 512-multiple, the shard_plan example's slot
+    n = 8
+    fp32 = scaling.reduce_scatter_bytes(((slot, 4),), n)
+    assert fp32 == (n - 1) * slot * 4
+    # scatter + slot-width gather < full-width ring allreduce wire
+    ring = scaling.ring_allreduce_cost(n, slot * n * 4)
+    assert fp32 + (n - 1) * slot * 4 <= ring["wire_bytes"]
+    i8 = scaling.reduce_scatter_bytes(((slot, 4),), n, wire="int8")
+    i4 = scaling.reduce_scatter_bytes(((slot, 4),), n, wire="int4")
+    assert i8 / fp32 == 516 / 2048
+    assert i4 / fp32 == 258 / 2048
+    assert scaling.reduce_scatter_bytes(
+        ((slot, 4),), n, wire="int8_ef"
+    ) == i8
+    # multi-group sums per group
+    two = scaling.reduce_scatter_bytes(((slot, 4), (512, 2)), n)
+    assert two == fp32 + (n - 1) * 512 * 2
+    cost = scaling.ring_reduce_scatter_cost(n, slot * 4)
+    assert cost["latency_hops"] == n - 1
+    assert cost["wire_bytes"] == float((n - 1) * slot * 4)
+
+
 def test_neighbor_allreduce_beats_allreduce_in_hlo_collective_count():
     """For one-peer schedules the compiled gossip program contains strictly
     fewer collectives than the psum path's logical content at every N>2."""

@@ -33,15 +33,18 @@ SIZE = 8
 def fresh_context(cpu_devices, monkeypatch):
     monkeypatch.delenv("BLUEFOG_SHARD", raising=False)
     monkeypatch.delenv("BLUEFOG_SHARD_MASTER", raising=False)
+    monkeypatch.delenv("BLUEFOG_SHARD_GRADS", raising=False)
     bf.init(devices=cpu_devices[:SIZE])
     yield
     bf.shutdown()
 
 
-def _shard_on(monkeypatch, master=False):
+def _shard_on(monkeypatch, master=False, grads=False):
     monkeypatch.setenv("BLUEFOG_SHARD", "1")
     if master:
         monkeypatch.setenv("BLUEFOG_SHARD_MASTER", "1")
+    if grads:
+        monkeypatch.setenv("BLUEFOG_SHARD_GRADS", "1")
 
 
 # -- layout algebra (host-side, no mesh) -------------------------------------
@@ -510,6 +513,12 @@ def test_shard_plan_cli(tmp_path):
         (r["start"], r["stop"]) for r in rep["owner_map"]
     )
     assert covered[0][0] == 0 and covered[-1][1] == 262145
+    # the ZeRO-2 gradient-leg columns ride along
+    assert rep["scatter_bytes_per_step"] < rep["allreduce_bytes_per_step"]
+    assert rep["grad_bytes_sharded"] < rep["grad_bytes_replicated"]
+    assert 0 < rep["grad_ratio"] < 1
+    assert rep["sharded_with_grads_fits"] is True
+    assert rep["replicated_with_grads_fits"] is False
 
 
 # -- review-hardening regressions --------------------------------------------
@@ -576,3 +585,236 @@ def test_owner_map_clamped_for_padding_owners():
     assert rows[1]["padding"] == 2 * slot - 600
     assert rows[-1]["start"] == rows[-1]["stop"] == 600
     assert rows[-1]["padding"] == slot
+
+# -- ZeRO-2: reduce-scatter gradient sharding (BLUEFOG_SHARD_GRADS) ----------
+
+
+def test_zero2_matches_replicated_and_numpy_oracle(monkeypatch):
+    """The ZeRO-2 headline pin: lowering the gradient leg to the ring
+    reduce-scatter (each rank receives ONLY its owned slot) keeps the
+    trajectory inside the SAME envelope as the replicated allreduce and
+    the numpy Adam replay — the scatter's fixed reduction order is the
+    allreduce's reduction, delivered in pieces."""
+    c1, _c2 = _targets()
+    _, p_rep, _ = _run_grad_family()
+    bf.shutdown()
+    _shard_on(monkeypatch, grads=True)
+    bf.init(devices=jax.devices("cpu")[:SIZE])
+    opt, p_z2, state = _run_grad_family()
+    assert isinstance(state, sharding.ShardedOptState)
+    lay = opt._shard_layout
+    assert lay is not None and lay.grads
+    for key in ("a", "b"):
+        wz, wr = np.asarray(p_z2[key]), np.asarray(p_rep[key])
+        assert np.abs(wz - wz[0]).max() == 0.0  # bit-identical replicas
+        np.testing.assert_allclose(wz, wr, rtol=0, atol=1e-6)
+    oracle = _np_adam_oracle(c1.mean(0), 6)
+    np.testing.assert_allclose(
+        np.asarray(p_z2["a"])[0], oracle, rtol=0, atol=1e-4
+    )
+    # the dispatched program really is the scattered one
+    assert [
+        k for k in bf.get_context().op_cache
+        if isinstance(k, tuple) and "scatter" in map(str, k)
+    ]
+
+
+def test_zero2_fused_matches_two_program(monkeypatch):
+    """make_train_step and opt.step share _combine_update through the
+    scatter branch too: the fused ZeRO-2 step is the same math as the
+    two-program path."""
+    _shard_on(monkeypatch, grads=True)
+    c1, _ = _targets()
+    ct = jnp.asarray(c1)
+
+    def loss_fn(params, c):
+        return 0.5 * jnp.sum((params["a"] - c) ** 2)
+
+    def make():
+        opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.05))
+        params = {"a": bf.worker_values(lambda r: np.zeros(D1, np.float32))}
+        return opt, params, opt.init(params)
+
+    opt, params, state = make()
+    for _ in range(4):
+        params, state = opt.step(
+            params, state, {"a": params["a"] - ct}
+        )
+    opt2, p2, s2 = make()
+    train = opt2.make_train_step(loss_fn)
+    for _ in range(4):
+        p2, s2, _loss = train(p2, s2, ct)
+    np.testing.assert_allclose(
+        np.asarray(p2["a"]), np.asarray(params["a"]), rtol=0, atol=1e-6
+    )
+
+
+def test_zero2_quantized_scatter_tiers(monkeypatch):
+    """The scatter leg speaks the PR-8 wire tiers: int8 block-scaled
+    wire converges within the quantization envelope; int8_ef holds a
+    per-slot CHOCO residual that accumulates shipped error."""
+    c1, c2 = _targets()
+    _, p_rep, _ = _run_grad_family()
+    bf.shutdown()
+    _shard_on(monkeypatch, grads=True)
+    bf.init(devices=jax.devices("cpu")[:SIZE])
+
+    def run(compression):
+        opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.05))
+        opt.compression = compression
+        params = {
+            "a": bf.worker_values(lambda r: np.zeros(D1, np.float32)),
+            "b": bf.worker_values(lambda r: np.zeros(D2, np.float32)),
+        }
+        state = opt.init(params)
+        for _ in range(6):
+            grads = {
+                "a": params["a"] - jnp.asarray(c1),
+                "b": params["b"] - jnp.asarray(c2),
+            }
+            params, state = opt.step(params, state, grads)
+        return opt, params
+
+    opt8, p8 = run("int8")
+    dev8 = max(
+        np.abs(np.asarray(p8[k]) - np.asarray(p_rep[k])).max()
+        for k in ("a", "b")
+    )
+    assert dev8 < 0.05
+    optef, pef = run("int8_ef")
+    assert optef._scatter_ef, "scatter residual state missing"
+    devef = max(
+        np.abs(np.asarray(pef[k]) - np.asarray(p_rep[k])).max()
+        for k in ("a", "b")
+    )
+    assert devef < 0.05
+    resid = sum(float(jnp.abs(e).sum()) for e in optef._scatter_ef)
+    assert resid > 0
+
+
+def test_zero1_program_verbatim_when_grads_off(monkeypatch):
+    """BLUEFOG_SHARD=1 WITHOUT gradient sharding is the PR-14 program
+    verbatim: layout carries no grads flag, zero scatter-tagged cache
+    keys, no scatter residual state."""
+    _shard_on(monkeypatch)
+    opt, _params, state = _run_grad_family(steps=3)
+    assert isinstance(state, sharding.ShardedOptState)
+    assert opt._shard_layout.grads is False
+    assert not getattr(opt, "_scatter_ef", None)
+    assert not [
+        k for k in bf.get_context().op_cache
+        if isinstance(k, tuple) and "scatter" in map(str, k)
+    ]
+
+
+def test_shard_grads_flip_no_reshard_no_alias(monkeypatch):
+    """Flipping BLUEFOG_SHARD_GRADS between steps rebuilds the layout
+    (new cache key — the two programs never alias) WITHOUT a reshard:
+    the state rows are laid out identically, only the gradient leg's
+    lowering changes."""
+    _shard_on(monkeypatch, grads=True)
+    c1, c2 = _targets()
+    opt, params, state = None, None, None
+    opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.05))
+    params = {
+        "a": bf.worker_values(lambda r: np.zeros(D1, np.float32)),
+        "b": bf.worker_values(lambda r: np.zeros(D2, np.float32)),
+    }
+    state = opt.init(params)
+
+    def one():
+        grads = {
+            "a": params["a"] - jnp.asarray(c1),
+            "b": params["b"] - jnp.asarray(c2),
+        }
+        return opt.step(params, state, grads)
+
+    params, state = one()
+    assert opt._shard_layout.grads is True
+    reshards0 = opt._shard_reshards
+
+    def step_keys():
+        return {
+            k for k in bf.get_context().op_cache
+            if isinstance(k, tuple) and k and k[0] == "opt_step"
+        }
+
+    keys_z2 = step_keys()
+    monkeypatch.delenv("BLUEFOG_SHARD_GRADS")
+    params, state = one()
+    assert opt._shard_layout.grads is False
+    assert opt._shard_reshards == reshards0  # flip is NOT a reshard
+    keys_both = step_keys()
+    assert keys_both > keys_z2  # the ZeRO-1 program got its own key
+    monkeypatch.setenv("BLUEFOG_SHARD_GRADS", "1")
+    params, state = one()
+    assert opt._shard_layout.grads is True
+    assert opt._shard_reshards == reshards0
+    # back on ZeRO-2: the ORIGINAL key is reused, nothing new compiled
+    assert step_keys() == keys_both
+    scatter_tagged = {
+        k for k in keys_both if "scatter" in map(str, k)
+    }
+    assert scatter_tagged and scatter_tagged < keys_both
+
+
+def test_zero2_elastic_kill_repair_rescatters(monkeypatch):
+    """kill -> repair under ZeRO-2: the re-shard rebuilds a
+    grads-carrying layout, the re-scattered program dispatches under a
+    new key with zero stale dispatches, and training continues with
+    bit-identical replicas."""
+    _shard_on(monkeypatch, grads=True)
+    c1, _ = _targets()
+    session = bf.elastic.start(policy="average")
+    session.inject("kill", rank=3, step=4)
+    opt = bf.DistributedGradientAllreduceOptimizer(optax.adam(0.02))
+    guard = bf.elastic.guard(opt)
+    params = {"a": bf.worker_values(lambda r: np.zeros(D1, np.float32))}
+    state = opt.init(params)
+    for _ in range(8):
+        params, state = guard.step(
+            params, state, {"a": params["a"] - jnp.asarray(c1)}
+        )
+    lay1 = opt._shard_layout
+    assert lay1.live == (0, 1, 2, 4, 5, 6, 7)
+    assert lay1.grads is True  # the re-shard kept the gradient leg
+    assert opt._shard_reshards == 1
+    assert session.stale_dispatches == 0
+    scatter_keys = {
+        k for k in bf.get_context().op_cache
+        if isinstance(k, tuple) and k and k[0] == "opt_step"
+        and "scatter" in map(str, k)
+    }
+    assert len(scatter_keys) == 2  # pre-kill and post-repair programs
+    w = np.asarray(params["a"])
+    assert np.isfinite(w).all()
+    assert np.abs(w - w[0]).max() == 0.0
+    bf.elastic.stop()
+
+
+def test_zero2_metrics_and_accounting(monkeypatch):
+    from bluefog_tpu import metrics
+
+    _shard_on(monkeypatch, grads=True)
+    monkeypatch.setenv("BLUEFOG_METRICS", "1")
+    metrics.reset()
+    opt, _params, _state = _run_grad_family(steps=2)
+    assert metrics.peek("bluefog.shard.grads").value == 1
+    assert metrics.peek("bluefog.shard.scatter_bytes").value > 0
+    assert metrics.peek("bluefog.shard.grad_bytes").value > 0
+    lay = opt._shard_layout
+    g = lay.groups[0]
+    # the layout algebra the gauges are built from
+    assert sharding.scatter_wire_bytes(lay) == (SIZE - 1) * 4 * g.slot
+    assert sharding.grad_bytes(lay, sharded=True) == 4 * g.slot
+    assert sharding.grad_bytes(lay, sharded=False) == 4 * g.elems
+    assert (
+        sharding.scatter_wire_bytes(lay)
+        < sharding.allreduce_wire_bytes(lay)
+    )
+    # wire accounting follows the scatter byte model when grads are on
+    assert metrics.peek("bluefog.shard.scatter_bytes").value == (
+        2 * scaling.reduce_scatter_bytes(
+            ((g.slot, 4),), SIZE
+        )
+    )

@@ -129,6 +129,13 @@ SHARD_DERIVED = {
     "state_bytes_measured", "shard_ratio", "pad_ratio",
     "gather_bytes_per_step", "budget_bytes", "slot_elems",
     "traj_max_dev",
+    # ZeRO-2 gradient-leg columns (BLUEFOG_SHARD_GRADS): reduced-
+    # gradient buffer bytes and reduce-scatter wire pricing are the
+    # same layout arithmetic, extended down the memory axis.
+    "grad_bytes_replicated_measured", "grad_bytes_sharded_measured",
+    "grad_ratio_measured", "grad_pad_ratio", "scatter_bytes_per_step",
+    "allreduce_bytes_per_step", "scatter_plus_gather",
+    "allreduce_plus_gather", "zero2_max_dev", "zero2_oracle_max_dev",
 }
 
 # Memory-observatory columns that arrived with the memory evidence

@@ -6,10 +6,12 @@ The planning half of ``BLUEFOG_SHARD=1`` (docs/sharding.md): given a
 model's packed dtype groups, a worker count, and optionally a live
 subset, this prints the bucket-aligned owner map
 (:func:`bluefog_tpu.sharding.build_layout`), per-rank optimizer-state
-bytes (replicated vs sharded, fp32 master option), and the
-redistribution wire cost of the post-update all-gather — so an operator
-can answer "does this model's optimizer state fit the chip, and what
-does redistribution cost" before touching a mesh.
+bytes (replicated vs sharded, fp32 master option), the redistribution
+wire cost of the post-update all-gather, and the ZeRO-2 gradient-leg
+columns (reduce-scatter vs allreduce wire, peak reduced-gradient bytes
+under ``BLUEFOG_SHARD_GRADS=1``) — so an operator can answer "does this
+model's optimizer state fit the chip, and what does redistribution
+cost" before touching a mesh.
 
 Usage::
 
@@ -101,10 +103,32 @@ def build_report(args) -> dict:
             layout, live_only=True
         ),
     }
+    # the ZeRO-2 gradient leg (BLUEFOG_SHARD_GRADS=1): reduce-scatter
+    # ships N-1 owned slots instead of the allreduce's ~2(N-1)/N full
+    # payloads, and the reduced gradient the update consumes shrinks
+    # from full width to one slot per group
+    grad_rep = sharding.grad_bytes(layout, sharded=False)
+    grad_sh = sharding.grad_bytes(layout, sharded=True)
+    report.update({
+        "scatter_bytes_per_step": sharding.scatter_wire_bytes(layout),
+        "allreduce_bytes_per_step": sharding.allreduce_wire_bytes(layout),
+        "grad_bytes_replicated": grad_rep,
+        "grad_bytes_sharded": grad_sh,
+        "grad_ratio": round(grad_sh / grad_rep, 6) if grad_rep else 1.0,
+    })
     if args.budget is not None:
         report["budget_bytes"] = args.budget
         report["replicated_fits"] = replicated <= args.budget
         report["sharded_fits"] = sharded <= args.budget
+        # the ZeRO-2 verdict prices state + the reduced-gradient
+        # buffer together — the pair that actually coexists at the
+        # weight-update moment
+        report["replicated_with_grads_fits"] = (
+            replicated + grad_rep <= args.budget
+        )
+        report["sharded_with_grads_fits"] = (
+            sharded + grad_sh <= args.budget
+        )
     return report
 
 
@@ -139,11 +163,26 @@ def print_report(rep: dict) -> None:
         f"({_fmt_bytes(rep['gather_bytes_per_step_live_only'])} "
         "live-only ideal)"
     )
+    print(
+        "  gradient leg (BLUEFOG_SHARD_GRADS=1): reduce-scatter "
+        f"{_fmt_bytes(rep['scatter_bytes_per_step'])} per rank vs "
+        f"allreduce {_fmt_bytes(rep['allreduce_bytes_per_step'])}"
+    )
+    print(
+        "  peak reduced-gradient bytes: replicated "
+        f"{_fmt_bytes(rep['grad_bytes_replicated'])} -> scattered "
+        f"{_fmt_bytes(rep['grad_bytes_sharded'])} "
+        f"(x{rep['grad_ratio']:.4f})"
+    )
     if "budget_bytes" in rep:
         print(
             f"  budget {_fmt_bytes(rep['budget_bytes'])}: replicated "
             f"{'FITS' if rep['replicated_fits'] else 'EXCEEDS'}, "
-            f"sharded {'FITS' if rep['sharded_fits'] else 'EXCEEDS'}"
+            f"sharded {'FITS' if rep['sharded_fits'] else 'EXCEEDS'}; "
+            "with gradient buffer: replicated "
+            f"{'FITS' if rep['replicated_with_grads_fits'] else 'EXCEEDS'}"
+            ", sharded "
+            f"{'FITS' if rep['sharded_with_grads_fits'] else 'EXCEEDS'}"
         )
 
 
