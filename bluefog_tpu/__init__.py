@@ -96,6 +96,7 @@ from bluefog_tpu import attribution as doctor  # bf.doctor facade
 from bluefog_tpu import autotune
 from bluefog_tpu import health
 from bluefog_tpu import memory
+from bluefog_tpu import fleetsim
 from bluefog_tpu import sharding
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
@@ -352,6 +353,7 @@ __all__ = [
     "health",
     "sharding",
     "memory",
+    "fleetsim",
     "staleness",
     "metrics",
     "metrics_snapshot",

@@ -332,7 +332,7 @@ def score_candidate(
         )
         if ix is not None:
             use = [np.asarray(m, np.float64)[ix] for m in use]
-        rate = topo_mod.consensus_decay_rate(use)
+        rate, spec = topo_mod.consensus_decay_rate_info(use)
         # per-step wire cost of the schedule: mean over the period of
         # each step's minimal round count
         rounds = float(np.mean([
@@ -356,7 +356,7 @@ def score_candidate(
             for (s, d), f in factors.items() if w[s, d] != 0.0
         )
         use = degraded_matrix(w, factors) if factors else w
-        rate = topo_mod.consensus_decay_rate(
+        rate, spec = topo_mod.consensus_decay_rate_info(
             use[ix] if ix is not None else use
         )
 
@@ -380,6 +380,16 @@ def score_candidate(
             round(objective_s, 6) if objective_s is not None else None
         ),
         "eligible": bool(cand.get("eligible", True)),
+        # how the rate was obtained: dense oracle below
+        # BLUEFOG_SPECTRAL_DENSE_MAX, deflated Arnoldi over edge lists
+        # above — with the convergence residual the decision record
+        # discloses at fleet scale
+        "spectral": {
+            "engine": spec.get("engine"),
+            "matvecs": spec.get("matvecs", 0),
+            "residual": spec.get("residual", 0.0),
+            "converged": spec.get("converged", True),
+        },
     }
     if wire is not None:
         out["wire"] = wire

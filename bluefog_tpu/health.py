@@ -608,23 +608,32 @@ class HealthPlane:
         kind = "topology"
         if isinstance(plan, SchedulePlan):
             mats = [p.weight_matrix() for p in plan.plans]
-            rate = topo_mod.consensus_decay_rate(mats)
+            rate, spec = topo_mod.consensus_decay_rate_info(mats)
             kind = f"schedule(period={len(mats)})"
             self_w = float(np.mean([np.mean(np.diag(m)) for m in mats]))
         elif isinstance(plan, CommPlan):
             w = plan.weight_matrix()
-            rate = topo_mod.consensus_decay_rate(w)
+            rate, spec = topo_mod.consensus_decay_rate_info(w)
             kind = "plan"
             self_w = float(np.mean(np.diag(w)))
         else:
             w = topo_mod.mixing_matrix(ctx.load_topology())
-            rate = topo_mod.consensus_decay_rate(w)
+            rate, spec = topo_mod.consensus_decay_rate_info(w)
             self_w = float(np.mean(np.diag(w)))
         # mean self weight of the active combine: the `s` of the
         # stale-mixing companion polynomial the age-discounted
-        # prediction solves (bluefog_tpu.staleness.age_adjusted_rate)
+        # prediction solves (bluefog_tpu.staleness.age_adjusted_rate).
+        # `spectral` discloses how the number was obtained (dense oracle
+        # vs deflated Arnoldi over edge lists) with its convergence
+        # residual — the honesty field for fleet-scale predictions.
         meta = {"kind": kind, "slem": float(rate),
-                "self_weight": self_w}
+                "self_weight": self_w,
+                "spectral": {
+                    "engine": spec.get("engine"),
+                    "matvecs": spec.get("matvecs", 0),
+                    "residual": spec.get("residual", 0.0),
+                    "converged": spec.get("converged", True),
+                }}
         if rate >= 1.0 - 1e-9:
             # no contraction promised (disconnected / periodic):
             # publish "no prediction" rather than a vacuous 1.0

@@ -22,8 +22,16 @@ from bluefog_tpu.topology.graphs import (
     isPowerOf,
     mixing_matrix,
     second_largest_eigenvalue_modulus,
+    second_largest_eigenvalue_modulus_info,
     spectral_gap,
     consensus_decay_rate,
+    consensus_decay_rate_info,
+)
+from bluefog_tpu.topology.spectral import (
+    EdgeMatrix,
+    edges_from_dense,
+    live_submatrix_edges,
+    spectral_dense_max,
 )
 from bluefog_tpu.topology.dynamic import (
     GetDynamicOnePeerSendRecvRanks,
@@ -31,6 +39,7 @@ from bluefog_tpu.topology.dynamic import (
     GetInnerOuterRingDynamicSendRecvRanks,
     GetInnerOuterExpo2DynamicSendRecvRanks,
     one_peer_period_matrices,
+    one_peer_period_edges,
 )
 from bluefog_tpu.topology.infer import (
     InferSourceFromDestinationRanks,
@@ -62,13 +71,20 @@ __all__ = [
     "isPowerOf",
     "mixing_matrix",
     "second_largest_eigenvalue_modulus",
+    "second_largest_eigenvalue_modulus_info",
     "spectral_gap",
     "consensus_decay_rate",
+    "consensus_decay_rate_info",
+    "EdgeMatrix",
+    "edges_from_dense",
+    "live_submatrix_edges",
+    "spectral_dense_max",
     "GetDynamicOnePeerSendRecvRanks",
     "GetExp2DynamicSendRecvMachineRanks",
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
     "one_peer_period_matrices",
+    "one_peer_period_edges",
     "InferSourceFromDestinationRanks",
     "InferDestinationFromSourceRanks",
     "serpentine_device_order",

@@ -26,7 +26,25 @@ __all__ = [
     "GetInnerOuterRingDynamicSendRecvRanks",
     "GetInnerOuterExpo2DynamicSendRecvRanks",
     "one_peer_period_matrices",
+    "one_peer_period_edges",
 ]
+
+
+def _one_peer_period(topo: nx.DiGraph, period):
+    """Shared period walk: yields per-iteration ``[(send, recv_list)]``
+    for every rank over one full period (default = lcm of the per-rank
+    out-degrees)."""
+    import math
+
+    size = topo.number_of_nodes()
+    if period is None:
+        period = 1
+        for r in range(size):
+            deg = max(len(_sorted_out_neighbors(topo, r)), 1)
+            period = period * deg // math.gcd(period, deg)
+    iters = [GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
+    for _ in range(period):
+        yield [next(it) for it in iters]
 
 
 def _sorted_out_neighbors(topo: nx.DiGraph, rank: int) -> List[int]:
@@ -81,18 +99,9 @@ def one_peer_period_matrices(
     period-product predicted decay — a single iteration's matrix is
     rank-deficient in mixing terms (one peer per rank) and only the
     product contracts like the schedule actually does."""
-    import math
-
     size = topo.number_of_nodes()
-    if period is None:
-        period = 1
-        for r in range(size):
-            deg = max(len(_sorted_out_neighbors(topo, r)), 1)
-            period = period * deg // math.gcd(period, deg)
-    iters = [GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(size)]
     mats: List[np.ndarray] = []
-    for _ in range(period):
-        step = [next(it) for it in iters]
+    for step in _one_peer_period(topo, period):
         w = np.zeros((size, size))
         for j, (_send, recv) in enumerate(step):
             wt = 1.0 / (len(recv) + 1)
@@ -101,6 +110,27 @@ def one_peer_period_matrices(
                 w[i, j] = wt
         mats.append(w)
     return mats
+
+
+def one_peer_period_edges(topo: nx.DiGraph, period: int = None):
+    """Sparse twin of :func:`one_peer_period_matrices`: one weighted
+    edge dict ``{(i, j): w}`` per iteration of the period, O(N * degree)
+    memory instead of O(N^2) per step. Feed the
+    ``[(size, edges), ...]`` result straight to
+    :func:`bluefog_tpu.topology.consensus_decay_rate` — above
+    ``BLUEFOG_SPECTRAL_DENSE_MAX`` the period product is applied as
+    composed mat-vecs and the dense matrices never exist."""
+    size = topo.number_of_nodes()
+    out = []
+    for step in _one_peer_period(topo, period):
+        edges = {}
+        for j, (_send, recv) in enumerate(step):
+            wt = 1.0 / (len(recv) + 1)
+            edges[(j, j)] = wt
+            for i in recv:
+                edges[(i, j)] = wt
+        out.append((size, edges))
+    return out
 
 
 def GetExp2DynamicSendRecvMachineRanks(

@@ -35,8 +35,10 @@ __all__ = [
     "isPowerOf",
     "mixing_matrix",
     "second_largest_eigenvalue_modulus",
+    "second_largest_eigenvalue_modulus_info",
     "spectral_gap",
     "consensus_decay_rate",
+    "consensus_decay_rate_info",
 ]
 
 
@@ -271,7 +273,7 @@ def mixing_matrix(topo: nx.DiGraph) -> np.ndarray:
     return nx.to_numpy_array(topo)
 
 
-def second_largest_eigenvalue_modulus(w: np.ndarray) -> float:
+def second_largest_eigenvalue_modulus(w) -> float:
     """SLEM of a stochastic combine matrix: the modulus of the largest
     eigenvalue once one Perron root (the eigenvalue nearest 1) is
     removed.
@@ -282,16 +284,24 @@ def second_largest_eigenvalue_modulus(w: np.ndarray) -> float:
     matrix reports SLEM 1.0: no contraction is promised, and the
     observatory treats the prediction as "none". Eigenvalues of ``W``
     and ``W^T`` coincide, so either orientation convention gives the
-    same answer."""
-    w = np.asarray(w, np.float64)
-    if w.shape[0] <= 1:
-        return 0.0
-    eig = np.linalg.eigvals(w)
-    # drop ONE root closest to 1 (the Perron eigenvalue); ties beyond it
-    # (disconnected/periodic chains) stay and correctly report 1.0
-    drop = int(np.argmin(np.abs(eig - 1.0)))
-    rest = np.delete(eig, drop)
-    return float(np.max(np.abs(rest))) if rest.size else 0.0
+    same answer.
+
+    Routed through :mod:`bluefog_tpu.topology.spectral`: dense eigvals
+    at ``N <= BLUEFOG_SPECTRAL_DENSE_MAX`` (default 64, the retained
+    oracle), deflated Arnoldi over the edge list above. Accepts a dense
+    array, a :class:`~bluefog_tpu.topology.spectral.EdgeMatrix`, or an
+    ``(n, {(i, j): w})`` edge-dict pair; use
+    :func:`second_largest_eigenvalue_modulus_info` for the structured
+    convergence/residual disclosure."""
+    return second_largest_eigenvalue_modulus_info(w)[0]
+
+
+def second_largest_eigenvalue_modulus_info(w) -> Tuple[float, dict]:
+    """:func:`second_largest_eigenvalue_modulus` plus the engine info
+    dict (``engine`` / ``matvecs`` / ``residual`` / ``converged``)."""
+    from bluefog_tpu.topology import spectral as _spectral
+
+    return _spectral.slem_info(w)
 
 
 def spectral_gap(w: np.ndarray) -> float:
@@ -309,19 +319,20 @@ def consensus_decay_rate(mats) -> float:
     A single matrix returns its SLEM. A sequence returns
     ``SLEM(W_K^T ... W_1^T)^(1/K)`` — the period-product contraction
     normalized back to one step, the quantity comparable against a
-    per-step measured decay series."""
-    if isinstance(mats, np.ndarray) and mats.ndim == 2:
-        mats = [mats]
-    mats = [np.asarray(m, np.float64) for m in mats]
-    if not mats:
-        return 1.0
-    prod = np.eye(mats[0].shape[0])
-    for m in mats:
-        # one gossip step is x -> W^T x, so the period product composes
-        # transposes in application order
-        prod = m.T @ prod
-    rho = second_largest_eigenvalue_modulus(prod)
-    return float(rho ** (1.0 / len(mats)))
+    per-step measured decay series. On the sparse path (``N >
+    BLUEFOG_SPECTRAL_DENSE_MAX``) the period product is applied as
+    composed mat-vecs and the N x N product is never materialized."""
+    return consensus_decay_rate_info(mats)[0]
+
+
+def consensus_decay_rate_info(mats) -> Tuple[float, dict]:
+    """:func:`consensus_decay_rate` plus the engine info dict
+    (``engine`` / ``matvecs`` / ``residual`` / ``converged`` /
+    ``period``) — the structured field health, autotune, and the
+    elastic repair verdicts disclose."""
+    from bluefog_tpu.topology import spectral as _spectral
+
+    return _spectral.decay_info(mats)
 
 
 def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph]) -> bool:
@@ -335,9 +346,22 @@ def IsTopologyEquivalent(topo1: Optional[nx.DiGraph], topo2: Optional[nx.DiGraph
         return False
     if topo1.number_of_edges() != topo2.number_of_edges():
         return False
-    a1 = nx.to_numpy_array(topo1).ravel()
-    a2 = nx.to_numpy_array(topo2).ravel()
-    return bool((a1 == a2).all())
+    # weighted edge dicts compared directly — O(edges) time/memory where
+    # the dense N x N form allocates megabytes at fleet scale. Zero-weight
+    # edges are dropped on both sides, exactly matching the dense array
+    # equality this replaced (a zero entry is indistinguishable from an
+    # absent edge once densified).
+    e1 = {
+        (u, v): d.get("weight", 1.0)
+        for u, v, d in topo1.edges(data=True)
+        if d.get("weight", 1.0) != 0.0
+    }
+    e2 = {
+        (u, v): d.get("weight", 1.0)
+        for u, v, d in topo2.edges(data=True)
+        if d.get("weight", 1.0) != 0.0
+    }
+    return e1 == e2
 
 
 def IsRegularGraph(topo: nx.DiGraph) -> bool:

@@ -1159,6 +1159,94 @@ def _check_memory(lines):
     assert anchor and anchor[0]["tflops"] > 0
 
 
+def _check_fleetscale(lines):
+    """FLEETSCALE_EVIDENCE.json (the committed BENCH_MODE=fleetscale
+    output) carries the acceptance facts: per-membership-event repair
+    cost sublinear in N over the {128..1024} sweep (growth exponent
+    < 1) with the dense baseline extrapolated by a DISCLOSED power-law
+    model rather than run at fleet scale; the 10% simultaneous
+    rank-loss storm at N=1024 repaired with zero stale dispatches
+    under full edge auditing (churn advisory filed, exact survivor
+    count); bounded controller decision latency at N=1024 with every
+    candidate scored by the sparse spectral engine; and the
+    sparse-vs-dense SLEM agreement spot check at the routing boundary
+    — plus provenance and the ambient anchor."""
+    _assert_provenance(lines)
+    scaling = [
+        l for l in lines if l.get("metric") == "fleetscale_event_scaling"
+    ]
+    assert scaling, lines
+    s = scaling[0]
+    assert s["sublinear"] is True
+    assert s["growth_exponent"] < 1.0
+    assert {c["n"] for c in s["cells"]} >= {128, 256, 512, 1024}
+    assert "dense_extrapolation_model" in s
+    assert s["dense_at_1024_ms_extrapolated"] > s["sparse_at_1024_ms"]
+    assert s["speedup_at_1024_extrapolated"] > 10.0
+    storm = [l for l in lines if l.get("metric") == "fleetscale_storm"]
+    assert storm, lines
+    st = storm[0]
+    assert st["n"] == 1024
+    assert st["stale_dispatches"] == 0
+    assert st["live_after"] == st["n"] - st["killed"]
+    assert st["killed"] == round(st["n"] * st["fraction"])
+    assert "fleet_churn" in st["advisories"]
+    decision = [
+        l for l in lines if l.get("metric") == "fleetscale_decision"
+    ]
+    assert decision, lines
+    d = decision[0]
+    assert d["decision_ms"] <= d["bound_ms"]
+    for name, cand in d["candidates"].items():
+        assert cand["spectral"]["engine"] == "sparse", (name, cand)
+    agree = [
+        l for l in lines if l.get("metric") == "fleetscale_agreement"
+    ]
+    assert agree, lines
+    assert agree[0]["worst_abs_diff"] <= agree[0]["tolerance"]
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_fleetscale_columns_are_tooling_gained(tmp_path):
+    """The fleet-scale evidence columns (event costs, exponent fits,
+    decision latency) against a pre-fleetsim artifact must read as
+    tooling-gained (FLEETSCALE_DERIVED), never a comparability
+    break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare, FLEETSCALE_DERIVED, TOOLING_DERIVED
+
+    assert FLEETSCALE_DERIVED <= TOOLING_DERIVED
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_fleetscale):
+        rows = [prov, {
+            "metric": "health_decay", "topology": "ring",
+            "n_workers": 8, "predicted_rate": 0.8,
+        }]
+        if with_fleetscale:
+            rows.append({
+                "metric": "fleetscale_storm", "n": 1024,
+                "stale_dispatches": 0, "worst_event_ms": 0.28,
+            })
+        path.write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
 # -- the committed-evidence sweep ---------------------------------------------
 #
 # One parametrized test over EVERY committed evidence artifact: each
@@ -1179,6 +1267,7 @@ EVIDENCE_CHECKS = {
     "STALENESS_EVIDENCE.json": _check_staleness,
     "SHARD_EVIDENCE.json": _check_shard,
     "MEMORY_EVIDENCE.json": _check_memory,
+    "FLEETSCALE_EVIDENCE.json": _check_fleetscale,
 }
 
 

@@ -1,0 +1,331 @@
+"""Fleet simulator tests (:mod:`bluefog_tpu.fleetsim`).
+
+Two layers: the sparse repair-weight algebra pinned against the dense
+``repaired_matrix`` oracle (every policy, random live subsets, degrade
+factors, incremental-vs-batch kills), and the thousand-rank scenarios
+the simulator exists for — churn storms, cascading repairs, whole-
+region loss, plan-cache key discipline with the zero-stale-dispatch
+tripwire, and the fleet aggregation / decision probe oracles. All
+deterministic on the fault-plan step clock; N=1024 cases run in
+milliseconds because the per-event work is O(degree^2), which is the
+tentpole claim.
+"""
+
+import numpy as np
+import pytest
+
+from bluefog_tpu import fleetsim, health
+from bluefog_tpu.elastic.repair import repaired_matrix
+
+ORACLE_TOL = 1e-12
+
+
+def _dense(edges, n):
+    w = np.zeros((n, n))
+    for (i, j), v in edges.items():
+        w[i, j] = v
+    return w
+
+
+# -- sparse repair algebra vs the dense oracle --------------------------------
+
+
+@pytest.mark.parametrize("kind", ["ring", "exp2", "mesh", "star", "rrd"])
+@pytest.mark.parametrize("policy", ["average", "receiver", "push_sum"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_repair_algebra_matches_dense_oracle(kind, policy, seed):
+    rng = np.random.RandomState(seed)
+    for n in (4, 8, 16):
+        edges = fleetsim.base_edges(n, kind, seed=3)
+        w = _dense(edges, n)
+        k = int(rng.randint(1, max(2, n // 2)))
+        dead = sorted(rng.choice(n, size=k, replace=False).tolist())
+        live = [r for r in range(n) if r not in dead]
+        degr = {int(live[0]): 0.5} if seed == 1 else {}
+        ft = fleetsim.FleetTopology(n, edges, policy)
+        ft.kill(dead)
+        for r, f in degr.items():
+            ft.degrade(r, f)
+        want = repaired_matrix(w, live, policy=policy, degraded=degr)
+        got = ft.to_dense()
+        np.testing.assert_allclose(got, want, atol=ORACLE_TOL)
+        # the O(degree) per-rank views agree with the full matrix
+        for j in live:
+            self_w, nbrs = ft.recv_weights(j)
+            assert abs(self_w - want[j, j]) <= ORACLE_TOL
+            for i in range(n):
+                if i != j:
+                    assert abs(nbrs.get(i, 0.0) - want[i, j]) <= ORACLE_TOL
+
+
+def test_incremental_kills_match_batch_kill():
+    """Lazy per-neighborhood invalidation must compose: killing ranks
+    one at a time lands on the same matrix as one batch kill."""
+    n = 24
+    edges = fleetsim.base_edges(n, "exp2")
+    dead = [3, 7, 11, 20]
+    live = [r for r in range(n) if r not in dead]
+    for policy in ("average", "receiver", "push_sum"):
+        batch = fleetsim.FleetTopology(n, edges, policy)
+        batch.kill(dead)
+        incr = fleetsim.FleetTopology(n, edges, policy)
+        for r in dead:
+            incr.kill([r])
+        np.testing.assert_allclose(incr.to_dense(), batch.to_dense(),
+                                   atol=0)
+        want = repaired_matrix(_dense(edges, n), live, policy=policy)
+        np.testing.assert_allclose(batch.to_dense(), want,
+                                   atol=ORACLE_TOL)
+
+
+def test_revive_restores_base_weights():
+    n = 16
+    edges = fleetsim.base_edges(n, "ring")
+    ft = fleetsim.FleetTopology(n, edges, "receiver")
+    base = ft.to_dense()
+    ft.kill([2, 9])
+    ft.revive(2)
+    ft.revive(9)
+    np.testing.assert_allclose(ft.to_dense(), base, atol=0)
+
+
+def test_average_policy_partition_unions_ring():
+    """Killing a star's center disconnects the survivors; the average
+    policy must union in the survivor ring (and flag the partition)."""
+    n = 8
+    edges = fleetsim.base_edges(n, "star")
+    ft = fleetsim.FleetTopology(n, edges, "average")
+    ft.kill([0])  # the hub
+    w = ft.to_dense()
+    assert ft.partitioned
+    live = ft.live_ranks()
+    want = repaired_matrix(_dense(edges, n), live, policy="average")
+    np.testing.assert_allclose(w, want, atol=ORACLE_TOL)
+    rate, spec = ft.decay_info()
+    assert rate is not None and 0.0 < rate < 1.0
+    assert spec["converged"]
+
+
+# -- fleet-scale scenarios -----------------------------------------------------
+
+
+def test_churn_storm_n1024_zero_stale_dispatches():
+    """The headline scenario: 10% of a 1024-rank fleet lost in one
+    step, repaired before the next dispatch, with the full edge audit
+    on — any plan surviving the repair with an edge into a dead rank
+    would trip the stale counter."""
+    n = 1024
+    plan = fleetsim.storm_plan(n, 0.10, step=5, seed=1)
+    killed = len(plan.faults)
+    vf = fleetsim.VirtualFleet(n, topology="exp2", policy="receiver",
+                               plan=plan, audit_edges=True, seed=1)
+    vf.run(12)
+    s = vf.summary()
+    assert s["stale_dispatches"] == 0
+    assert s["live"] == n - killed
+    assert s["repairs"] == 1  # simultaneous storm = one repair event
+    assert s["dead"] == killed
+    assert "fleet_churn" in [a["kind"] for a in s["advisories"]]
+    # cache discipline: exactly one compile before the storm, one after
+    assert s["cache_misses"] == 2
+    assert s["cache_hits"] == 12 - 2
+
+
+def test_cascading_repairs_each_event_recompiles():
+    """A kill per step: every event must bump the topology version and
+    miss the plan cache exactly once (old keys can never match)."""
+    n = 256
+    kills = 10
+    plan = fleetsim.cascade_plan(n, kills, start_step=2, stride=1, seed=4)
+    vf = fleetsim.VirtualFleet(n, topology="exp2", policy="receiver",
+                               plan=plan, audit_edges=True, seed=4)
+    vf.run(kills + 5)
+    s = vf.summary()
+    assert s["stale_dispatches"] == 0
+    assert s["repairs"] == kills
+    assert s["topo_version"] == kills
+    assert s["cache_misses"] == kills + 1
+    assert s["live"] == n - kills
+    # membership epoch advanced once per transition
+    assert s["epoch"] == kills
+
+
+def test_region_loss_repairs_and_aggregates():
+    """Whole-region loss (one contiguous quarter of the fleet): repair
+    completes, survivors still aggregate to the live-set mean."""
+    n = 128
+    plan = fleetsim.region_plan(n, 0, 32, step=3)
+    vf = fleetsim.VirtualFleet(n, topology="exp2", policy="receiver",
+                               plan=plan, audit_edges=True)
+    vf.run(8)
+    s = vf.summary()
+    assert s["stale_dispatches"] == 0
+    assert s["live"] == 96
+    # survivors' push-sum aggregate converges to the live mean
+    vals = np.zeros((n, 1))
+    vals[:, 0] = np.arange(n, dtype=np.float64)
+    rep = vf.aggregate(vals, rounds=40)
+    live_mean = np.mean(np.arange(32, 128))
+    assert rep["mean"][0] == pytest.approx(live_mean, rel=1e-6)
+    assert rep["residual"] < 1e-4
+
+
+def test_rejoin_after_storm():
+    n = 64
+    vf = fleetsim.VirtualFleet(n, topology="ring", policy="receiver")
+    vf.run(2)
+    base = vf.topo.to_dense()
+    assert vf.kill(5, step=2)
+    vf._repair([5], 2)
+    assert 5 not in vf.topo.live_ranks()
+    assert vf.rejoin(5)
+    vf.run(2)
+    assert vf.summary()["stale_dispatches"] == 0
+    assert 5 in vf.topo.live_ranks()
+    np.testing.assert_allclose(vf.topo.to_dense(), base, atol=0)
+
+
+def test_live_token_changes_on_every_transition():
+    vf = fleetsim.VirtualFleet(32, topology="ring")
+    t0 = vf.live_token()
+    vf.kill(3, step=0)
+    t1 = vf.live_token()
+    assert t1 != t0
+    vf.rejoin(3)
+    t2 = vf.live_token()
+    # same live set, but the epoch component still distinguishes the
+    # token (the device path's discipline: any transition recompiles)
+    assert t2 != t0 and t2 != t1
+    assert t2[1] == t0[1] and t2[2] == t0[2]  # live-hash/count restored
+
+
+def test_aggregate_matches_health_oracle():
+    """The sparse scatter-add lanes against the dense numpy oracle,
+    dead ranks excluded, all report fields."""
+    rng = np.random.RandomState(11)
+    for kind in ("ring", "exp2"):
+        n = 24
+        vf = fleetsim.VirtualFleet(n, topology=kind, policy="receiver")
+        dead = [1, 13]
+        for r in dead:
+            vf.kill(r, step=0)
+        vf._repair(dead, 0)
+        vals = rng.randn(n, 3)
+        got = vf.aggregate(vals, rounds=6)
+        want = health.fleet_aggregate_np(vf.topo.to_dense(), vals, 6,
+                                         dead=dead)
+        for key in ("mean", "min", "max"):
+            np.testing.assert_allclose(got[key], want[key], atol=1e-9)
+        assert got["residual"] == pytest.approx(want["residual"],
+                                                abs=1e-9)
+        assert got["live"] == want["live"]
+
+
+def test_decision_probe_uses_sparse_engine_at_scale():
+    n = 512
+    plan = fleetsim.storm_plan(n, 0.05, step=1, seed=2)
+    vf = fleetsim.VirtualFleet(n, topology="exp2", policy="receiver",
+                               plan=plan, audit_edges=False, seed=2)
+    vf.run(4)
+    row = vf.decision_probe()
+    assert row["chosen"] in row["candidates"]
+    assert row["decision_ms"] > 0.0
+    for name, cand in row["candidates"].items():
+        assert cand["spectral"]["engine"] == "sparse", (name, cand)
+        assert 0.0 < cand["rate"] <= 1.0
+    # the incumbent (repaired exp2) must beat the near-1-SLEM ring
+    assert row["candidates"]["current"]["rate"] < \
+        row["candidates"]["ring"]["rate"]
+
+
+def test_fleetsim_jsonl_dump(tmp_path, monkeypatch):
+    path = tmp_path / "fleet.jsonl"
+    monkeypatch.setenv(fleetsim.FLEETSIM_FILE_ENV, str(path))
+    plan = fleetsim.storm_plan(64, 0.1, step=2, seed=0)
+    vf = fleetsim.VirtualFleet(64, topology="exp2", plan=plan,
+                               audit_edges=True)
+    vf.run(5)
+    vf.decision_probe()
+    import json
+
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    metrics_seen = {r["metric"] for r in rows}
+    assert "fleetsim_repair" in metrics_seen
+    assert "fleetsim_advisory" in metrics_seen
+    assert "fleetsim_decision" in metrics_seen
+
+
+def test_fleetsim_report_tool_reads_dump(tmp_path, monkeypatch):
+    """tools/fleetsim_report.py reconstructs the storm timeline from
+    the JSONL dump alone."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    path = tmp_path / "fleet.jsonl"
+    monkeypatch.setenv(fleetsim.FLEETSIM_FILE_ENV, str(path))
+    plan = fleetsim.storm_plan(64, 0.1, step=2, seed=0)
+    vf = fleetsim.VirtualFleet(64, topology="exp2", plan=plan,
+                               audit_edges=True)
+    vf.run(5)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "fleetsim_report.py")
+    proc = subprocess.run(
+        [sys.executable, tool, "--dump", str(path), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["repairs"], "no repair events reconstructed"
+    assert report["repairs"][0]["step"] == 2
+    assert report["verdict"]["repair_events"] == 1
+
+
+def test_fault_kinds_other_than_kill_become_suspect_advisories():
+    from bluefog_tpu.elastic.faults import Fault, FaultPlan
+
+    plan = FaultPlan([Fault(kind="stall", rank=3, step=1, seconds=1.0)])
+    vf = fleetsim.VirtualFleet(16, topology="ring", plan=plan)
+    vf.run(3)
+    kinds = [a.kind for a in vf.advisories]
+    assert "fleet_suspect" in kinds
+    assert vf.summary()["live"] == 16  # no membership consequence
+
+
+def test_degrade_fault_triggers_repair():
+    from bluefog_tpu.elastic.faults import Fault, FaultPlan
+
+    n = 16
+    plan = FaultPlan([Fault(kind="degrade", rank=2, step=1, factor=0.5)])
+    vf = fleetsim.VirtualFleet(n, topology="ring", policy="receiver",
+                               plan=plan, audit_edges=True)
+    vf.run(4)
+    s = vf.summary()
+    assert s["stale_dispatches"] == 0
+    assert s["repairs"] == 1
+    want = repaired_matrix(
+        _dense(fleetsim.base_edges(n, "ring"), n), list(range(n)),
+        policy="receiver", degraded={2: 0.5},
+    )
+    np.testing.assert_allclose(vf.topo.to_dense(), want,
+                               atol=ORACLE_TOL)
+
+
+def test_per_event_cost_does_not_scale_with_fleet_size():
+    """The structural tentpole claim, pinned without wall-clock
+    flakiness: the number of ranks whose weights a kill touches is the
+    killed rank's neighborhood, independent of N."""
+    touched = {}
+    for n in (128, 1024):
+        ft = fleetsim.FleetTopology(n, fleetsim.base_edges(n, "ring"),
+                                    "receiver")
+        touched[n] = ft.kill([n // 2])
+    assert touched[128] == touched[1024]
+    # exp2 neighborhoods grow with log2(N) only
+    touched = {}
+    for n in (128, 1024):
+        ft = fleetsim.FleetTopology(n, fleetsim.base_edges(n, "exp2"),
+                                    "receiver")
+        touched[n] = ft.kill([n // 2])
+    assert touched[1024] <= touched[128] + 8

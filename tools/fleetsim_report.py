@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Reconstruct a fleet-simulator storm timeline from its artifacts.
+
+The fleet simulator (:mod:`bluefog_tpu.fleetsim`, docs/fleetsim.md)
+leaves two artifact kinds: the committed ``FLEETSCALE_EVIDENCE.json``
+(the ``BENCH_MODE=fleetscale`` JSON-lines family) and the optional
+per-run JSONL event dump (``BLUEFOG_FLEETSIM_FILE``). This tool joins
+either — or both — into the storm timeline an operator reads first:
+
+- the **event scaling table** (per-membership-event repair cost over
+  the N sweep, growth exponent, dense-baseline extrapolation with its
+  disclosed model),
+- the **storm timeline** (step-ordered repairs with detected ranks,
+  survivor count, epoch, topology version, per-event cost; advisories
+  inline; the worst event flagged),
+- the **decision block** (controller candidates, chosen topology,
+  measured decision latency),
+- the headline verdict line: stale dispatches (must be 0), repairs,
+  survivor count.
+
+Usage::
+
+    python tools/fleetsim_report.py FLEETSCALE_EVIDENCE.json
+    python tools/fleetsim_report.py --dump /tmp/fleetsim.jsonl
+    python tools/fleetsim_report.py FLEETSCALE_EVIDENCE.json --json
+
+No jax import, no live fleet needed. Exit status 0 on a parseable
+input set, 2 when nothing could be read.
+"""
+
+import argparse
+import json
+import sys
+from typing import List
+
+
+def load_lines(path: str) -> List[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
+
+
+def build_report(rows: List[dict]) -> dict:
+    scaling = next(
+        (r for r in rows if r.get("metric") == "fleetscale_event_scaling"),
+        None,
+    )
+    storm = next(
+        (r for r in rows if r.get("metric") == "fleetscale_storm"), None
+    )
+    decisions = [
+        r for r in rows
+        if r.get("metric") in ("fleetscale_decision", "fleetsim_decision")
+    ]
+    repairs = sorted(
+        (r for r in rows if r.get("metric") == "fleetsim_repair"),
+        key=lambda r: r.get("step", 0),
+    )
+    rejoins = sorted(
+        (r for r in rows if r.get("metric") == "fleetsim_rejoin"),
+        key=lambda r: r.get("step", 0),
+    )
+    advisories = [
+        r for r in rows if r.get("metric") == "fleetsim_advisory"
+    ]
+    worst = None
+    for r in repairs:
+        if worst is None or r.get("event_ms", 0) > worst.get("event_ms", 0):
+            worst = r
+    stale = storm["stale_dispatches"] if storm else None
+    return {
+        "scaling": scaling,
+        "storm": storm,
+        "decisions": decisions,
+        "repairs": repairs,
+        "rejoins": rejoins,
+        "advisories": advisories,
+        "worst_event": worst,
+        "verdict": {
+            "stale_dispatches": stale,
+            "clean": (stale == 0) if stale is not None else None,
+            "repair_events": len(repairs) if repairs else (
+                storm.get("repair_events") if storm else 0
+            ),
+        },
+    }
+
+
+def render(report: dict) -> str:
+    out = []
+    scaling = report["scaling"]
+    if scaling:
+        out.append("== event scaling "
+                   f"({scaling['topology']}, {scaling['policy']}) ==")
+        out.append(f"{'N':>6}  {'event_ms':>10}  {'max_ms':>10}")
+        for c in scaling["cells"]:
+            out.append(f"{c['n']:>6}  {c['event_ms_mean']:>10.4f}  "
+                       f"{c['event_ms_max']:>10.4f}")
+        out.append(
+            f"growth exponent {scaling['growth_exponent']} "
+            f"(sublinear: {scaling['sublinear']}); dense baseline "
+            f"extrapolated to N=1024: "
+            f"{scaling['dense_at_1024_ms_extrapolated']} ms "
+            f"(x{scaling['speedup_at_1024_extrapolated']} vs sparse)"
+        )
+        out.append(f"  model: {scaling['dense_extrapolation_model']}")
+        out.append("")
+    storm = report["storm"]
+    if storm:
+        out.append("== storm ==")
+        out.append(
+            f"N={storm['n']} killed={storm['killed']} "
+            f"({100 * storm['fraction']:.0f}%) "
+            f"live_after={storm['live_after']} "
+            f"repairs={storm['repair_events']} "
+            f"stale_dispatches={storm['stale_dispatches']} "
+            f"worst_event={storm['worst_event_ms']} ms"
+        )
+        out.append(f"advisories: {', '.join(storm['advisories']) or '-'}")
+        out.append("")
+    if report["repairs"]:
+        out.append("== repair timeline ==")
+        for r in report["repairs"]:
+            flag = " <-- worst" if r is report["worst_event"] else ""
+            out.append(
+                f"step {r['step']:>6}: -{len(r.get('detected', []))} "
+                f"ranks, live={r['live']}, epoch={r['epoch']}, "
+                f"topo v{r['topo_version']}, {r['event_ms']:.4f} ms"
+                f"{flag}"
+            )
+        out.append("")
+    for r in report["rejoins"]:
+        out.append(f"step {r['step']:>6}: rank {r['rank']} rejoined, "
+                   f"live={r['live']}")
+    for a in report["advisories"]:
+        out.append(f"advisory @{a.get('step')}: {a.get('kind')}")
+    for d in report["decisions"]:
+        out.append("== decision ==")
+        out.append(
+            f"n_live={d['n_live']} chosen={d['chosen']} "
+            f"latency={d['decision_ms']} ms"
+        )
+        for name, cand in d.get("candidates", {}).items():
+            spec = cand.get("spectral", {})
+            out.append(
+                f"  {name:>8}: rate={cand['rate']:.6f} "
+                f"rounds={cand['rounds']} engine={spec.get('engine')} "
+                f"matvecs={spec.get('matvecs')}"
+            )
+    v = report["verdict"]
+    out.append("")
+    out.append(
+        f"verdict: stale_dispatches={v['stale_dispatches']} "
+        f"clean={v['clean']} repair_events={v['repair_events']}"
+    )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "evidence", nargs="*",
+        help="FLEETSCALE_EVIDENCE.json (or any JSON-lines evidence "
+             "file carrying fleetscale_* rows)",
+    )
+    ap.add_argument(
+        "--dump", action="append", default=[],
+        help="fleetsim JSONL event dump (BLUEFOG_FLEETSIM_FILE); "
+             "repeatable",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the joined report as JSON instead of the table",
+    )
+    args = ap.parse_args(argv)
+
+    rows: List[dict] = []
+    readable = 0
+    for path in list(args.evidence) + list(args.dump):
+        try:
+            rows.extend(load_lines(path))
+            readable += 1
+        except OSError as e:
+            print(f"unreadable: {path}: {e}", file=sys.stderr)
+    if not readable:
+        print("no readable inputs", file=sys.stderr)
+        return 2
+    report = build_report(rows)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
