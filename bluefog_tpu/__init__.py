@@ -97,6 +97,7 @@ from bluefog_tpu import autotune
 from bluefog_tpu import health
 from bluefog_tpu import memory
 from bluefog_tpu import fleetsim
+from bluefog_tpu import federation
 from bluefog_tpu import sharding
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
@@ -354,6 +355,7 @@ __all__ = [
     "sharding",
     "memory",
     "fleetsim",
+    "federation",
     "staleness",
     "metrics",
     "metrics_snapshot",

@@ -444,6 +444,12 @@ class DecisionRecord:
     # a topology choice made on a chip near OOM reads differently in a
     # postmortem than one made with headroom to spare
     memory_pressure: bool = False
+    # which leg of the gossip fabric this decision searched: "flat" for
+    # the single-level default, "ici" / "dcn" when bf.federation splits
+    # the search per level (the intra-pod and gateway legs have
+    # different candidate pools AND different cost models, so their
+    # decisions must be attributable separately in the audit trail)
+    level: str = "flat"
 
     def to_json(self) -> dict:
         return {
@@ -463,6 +469,7 @@ class DecisionRecord:
             "dry_run": self.dry_run,
             "async_mode": self.async_mode,
             "memory_pressure": self.memory_pressure,
+            "level": self.level,
         }
 
 
@@ -491,6 +498,27 @@ def _memory_pressure() -> bool:
         return obs is not None and obs.pressure_active()
     except Exception:
         return False
+
+
+def _search_level(ctx) -> str:
+    """Which gossip-fabric level this controller's candidate search
+    covers: ``"flat"`` for the single-level default, ``"ici"`` when
+    :mod:`bluefog_tpu.federation` is active — the controller's
+    candidate pool (ring/exp2/mesh generators over the full rank set)
+    maps onto the intra-pod leg; the gateway leg is period-scheduled
+    by ``federation.choose_dcn_period`` against a consensus-rate
+    target, not swap-searched, so its decisions never appear under
+    this record stream."""
+    try:
+        from bluefog_tpu import federation
+
+        if federation.enabled() and (
+            federation.get_fabric(ctx.size) is not None
+        ):
+            return "ici"
+    except Exception:
+        pass
+    return "flat"
 
 
 # -- the controller ------------------------------------------------------------
@@ -1102,6 +1130,7 @@ class TopologyAutotuner:
             dry_run=self.dry_run,
             async_mode=_async_mode(),
             memory_pressure=_memory_pressure(),
+            level=_search_level(ctx),
         )
         self._emit(record)
         return record
@@ -1221,6 +1250,7 @@ class TopologyAutotuner:
                 dry_run=self.dry_run,
                 async_mode=_async_mode(),
                 memory_pressure=_memory_pressure(),
+                level=_search_level(ctx),
             )
             self._emit_verification(verdict)
             self._emit(record)

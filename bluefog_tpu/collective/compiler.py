@@ -76,6 +76,9 @@ from bluefog_tpu.topology import placement as _placement
 __all__ = [
     "ROUND_ALPHA_S",
     "ICI_LINK_BYTES_PER_S",
+    "DCN_ROUND_ALPHA_S",
+    "DCN_LINK_BYTES_PER_S",
+    "LINK_CLASSES",
     "DEFAULT_PAYLOAD_BYTES",
     "CompiledEdges",
     "compile_edges",
@@ -110,6 +113,18 @@ __all__ = [
 ROUND_ALPHA_S = 1.0e-6
 ICI_LINK_BYTES_PER_S = 9.0e10
 
+# DCN class constants — the inter-pod leg of a federated fabric
+# (bluefog_tpu.federation). Data-center-network latency is dominated by
+# the host round trip (~50 us vs the ~1 us ICI hop) and per-direction
+# bandwidth by the pod's WAN share (~25 GB/s aggregate is the v4 pod
+# sheet number; a conservative per-link figure). ``pipeline_eff`` 0: the
+# DCN leg crosses host NICs whose transfers already overlap, so chunk
+# pipelining is priced as a no-win (the chooser keeps 1 chunk).
+DCN_ROUND_ALPHA_S = 5.0e-5
+DCN_LINK_BYTES_PER_S = 2.5e10
+
+LINK_CLASSES = ("ici", "dcn")
+
 # ResNet50 f32 model payload — the gossip payload used throughout bench's
 # evidence set; the default basis for a plan's recorded predicted cost.
 DEFAULT_PAYLOAD_BYTES = 25_557_032 * 4
@@ -126,24 +141,51 @@ MAX_CHUNKS = 64
 
 # -- measured calibration ----------------------------------------------------
 
-_CAL: Optional[Dict[str, float]] = None
+# Per-link-class calibration store. "ici" is the default class every
+# pre-federation caller lands on — an installed single-class pin keeps
+# exactly its old meaning. "dcn" is the inter-pod leg's class
+# (bluefog_tpu.federation); each class is priced, pinned, and probed
+# independently so one fabric's measurement can never leak into the
+# other's chunk chooser.
+_CAL: Dict[str, Dict[str, float]] = {}
 
-
-def calibration() -> Dict[str, object]:
-    """The active alpha-beta constants: the measured one-shot probe when
-    one has run (or was injected), else the ICI class defaults.
-    ``pipeline_eff`` in [0, 1] is the fraction of ideal chunk-pipeline
-    overlap the backend delivers (1 under the class defaults — the
-    torus-fabric assumption; ~0 on a backend whose independent
-    collectives already overlap, where chunking cannot win)."""
-    if _CAL is not None:
-        return dict(_CAL)
-    return {
+_CLASS_DEFAULTS: Dict[str, Dict[str, object]] = {
+    "ici": {
         "alpha_s": ROUND_ALPHA_S,
         "beta_bytes_per_s": ICI_LINK_BYTES_PER_S,
         "pipeline_eff": 1.0,
         "source": "class-constants",
-    }
+    },
+    "dcn": {
+        "alpha_s": DCN_ROUND_ALPHA_S,
+        "beta_bytes_per_s": DCN_LINK_BYTES_PER_S,
+        "pipeline_eff": 0.0,
+        "source": "class-constants",
+    },
+}
+
+
+def _check_link_class(link_class: str) -> str:
+    if link_class not in LINK_CLASSES:
+        raise ValueError(
+            f"link_class must be one of {LINK_CLASSES}, got {link_class!r}"
+        )
+    return link_class
+
+
+def calibration(link_class: str = "ici") -> Dict[str, object]:
+    """The active alpha-beta constants for one link class: the measured
+    one-shot probe when one has run (or was injected), else the class
+    defaults. ``pipeline_eff`` in [0, 1] is the fraction of ideal
+    chunk-pipeline overlap the backend delivers (1 under the ICI class
+    defaults — the torus-fabric assumption; ~0 on a backend whose
+    independent collectives already overlap, where chunking cannot win;
+    0 for the DCN class, whose NIC transfers overlap by themselves).
+    The returned dict always echoes its ``link_class``."""
+    cal = _CAL.get(_check_link_class(link_class))
+    out = dict(cal) if cal is not None else dict(_CLASS_DEFAULTS[link_class])
+    out["link_class"] = link_class
+    return out
 
 
 def set_calibration(
@@ -151,11 +193,13 @@ def set_calibration(
     beta_bytes_per_s: float,
     pipeline_eff: float = 1.0,
     source: str = "manual",
+    link_class: str = "ici",
 ) -> None:
-    """Install cost-model constants (tests; or a deployment that probes
-    once and pins the result)."""
-    global _CAL
-    _CAL = {
+    """Install cost-model constants for one link class (tests; or a
+    deployment that probes once and pins the result). The default class
+    ``"ici"`` preserves the pre-federation single-class behavior —
+    existing pins keep pinning exactly what they pinned."""
+    _CAL[_check_link_class(link_class)] = {
         "alpha_s": float(alpha_s),
         "beta_bytes_per_s": float(beta_bytes_per_s),
         "pipeline_eff": min(1.0, max(0.0, float(pipeline_eff))),
@@ -163,9 +207,13 @@ def set_calibration(
     }
 
 
-def clear_calibration() -> None:
-    global _CAL
-    _CAL = None
+def clear_calibration(link_class: Optional[str] = None) -> None:
+    """Drop installed calibration for ``link_class``, or every class
+    when None (the pre-federation call shape)."""
+    if link_class is None:
+        _CAL.clear()
+    else:
+        _CAL.pop(_check_link_class(link_class), None)
 
 
 def calibrate(
@@ -174,6 +222,7 @@ def calibrate(
     large_elems: int = 1 << 21,
     steps: int = 4,
     windows: int = 2,
+    link_class: str = "ici",
 ) -> Dict[str, object]:
     """One-shot measured probe for the cost-model constants.
 
@@ -196,13 +245,23 @@ def calibrate(
     every cost function below prices with it from then on. Invoked
     explicitly by ``BENCH_MODE=plan`` and lazily by the chooser when
     ``BLUEFOG_PLAN_CALIBRATE=1``.
+
+    ``link_class`` selects which class's constants the probe installs.
+    Only ``"ici"`` is probe-able from inside one pod (the ambient
+    devices ARE the ICI fabric); ``calibrate(link_class="dcn")`` honors
+    an installed per-class pin (``set_calibration(...,
+    link_class="dcn")`` — the deployment declares what it measured out
+    of band) and otherwise returns the DCN class defaults, because no
+    single-host probe can time a cross-pod wire it does not have.
     """
-    global _CAL
-    if _CAL is not None and not force:
+    _check_link_class(link_class)
+    if _CAL.get(link_class) is not None and not force:
         # honor ANY installed calibration (a prior probe or a
         # set_calibration() pin) — a deployment that pinned constants
         # must not be silently re-probed by the lazy autocalibrate path
-        return dict(_CAL)
+        return calibration(link_class)
+    if link_class != "ici":
+        return calibration(link_class)
 
     import numpy as np
     import jax
@@ -275,7 +334,7 @@ def calibrate(
     gain = t_mono / max(t_chunk, 1e-9)
     pipeline_eff = min(1.0, max(0.0, (gain - 1.0) / (ideal_gain - 1.0)))
 
-    _CAL = {
+    _CAL["ici"] = {
         "alpha_s": float(alpha),
         "beta_bytes_per_s": float(beta),
         "pipeline_eff": float(pipeline_eff),
@@ -283,7 +342,7 @@ def calibrate(
         "probe_devices": n,
         "probe_gain_2round_4chunk": float(gain),
     }
-    return dict(_CAL)
+    return calibration("ici")
 
 
 def _maybe_autocalibrate() -> None:
@@ -297,18 +356,23 @@ def _maybe_autocalibrate() -> None:
 # -- cost model --------------------------------------------------------------
 
 
-def round_cost_s(payload_bytes: float, congestion: float = 1.0) -> float:
+def round_cost_s(
+    payload_bytes: float, congestion: float = 1.0, link_class: str = "ici",
+) -> float:
     """Cost of one ppermute round: fixed latency + payload transfer.
     ``congestion`` is the round's max directed-link load under the route
     model (:func:`bluefog_tpu.topology.placement.perm_congestion`) — L
-    transfers sharing a link serialize on it."""
-    cal = calibration()
+    transfers sharing a link serialize on it. ``link_class`` picks the
+    calibrated alpha-beta the round rides ("ici" / "dcn")."""
+    cal = calibration(link_class)
     return cal["alpha_s"] + congestion * payload_bytes / cal["beta_bytes_per_s"]
 
 
-def plan_cost_s(n_rounds: int, payload_bytes: float) -> float:
+def plan_cost_s(
+    n_rounds: int, payload_bytes: float, link_class: str = "ici",
+) -> float:
     """Rounds are sequential: plan cost = rounds x per-round cost."""
-    return n_rounds * round_cost_s(payload_bytes)
+    return n_rounds * round_cost_s(payload_bytes, link_class=link_class)
 
 
 def degraded_round_penalty_s(
@@ -331,6 +395,7 @@ def pipelined_cost_s(
     payload_bytes: float,
     n_chunks: int,
     congestions: Sequence[float],
+    link_class: str = "ici",
 ) -> float:
     """Cost of a chunked wavefront schedule over rounds with the given
     congestion factors.
@@ -343,7 +408,7 @@ def pipelined_cost_s(
     cost plus its extra per-chunk launches, so the chooser never picks
     what the fabric cannot deliver.
     """
-    cal = calibration()
+    cal = calibration(link_class)
     alpha, beta, gamma = (
         cal["alpha_s"], cal["beta_bytes_per_s"], cal["pipeline_eff"]
     )
@@ -392,6 +457,7 @@ class CompiledEdges:
     inject: Optional[Tuple[Tuple[int, ...], ...]] = None
     delivery: Optional[Tuple[Tuple[Tuple[int, int], int], ...]] = None
     congestion: Tuple[float, ...] = ()
+    link_class: str = "ici"  # which calibrated alpha-beta priced this plan
 
 
 def _canonical(edges: Iterable[Tuple[int, int]], size: int) -> Tuple[Tuple[int, int], ...]:
@@ -667,6 +733,7 @@ def compile_edges(
     size: int,
     method: str = "auto",
     payload_bytes: Optional[float] = None,
+    link_class: str = "ici",
 ) -> CompiledEdges:
     """Compile a directed edge set into ppermute rounds.
 
@@ -691,10 +758,14 @@ def compile_edges(
         )
     from bluefog_tpu import metrics
 
+    _check_link_class(link_class)
     payload = DEFAULT_PAYLOAD_BYTES if payload_bytes is None else payload_bytes
     canon = _canonical(edges, size)
     dims = _placement.declared_torus_dims(size)
-    key = (canon, size, method, payload, dims)
+    # the default class keeps the pre-federation key shape verbatim (the
+    # bitwise flat-fabric pin); a non-default class compiles its own entry
+    key = (canon, size, method, payload, dims) if link_class == "ici" \
+        else (canon, size, method, payload, dims, link_class)
     hit = _COMPILE_CACHE.get(key)
     if hit is not None:
         metrics.counter("bluefog.plan_cache.hits").inc()
@@ -703,7 +774,7 @@ def compile_edges(
 
     naive = offset_perms(canon, size)
     bound = min_rounds(canon, size)
-    offset_cost = plan_cost_s(len(naive), payload)
+    offset_cost = plan_cost_s(len(naive), payload, link_class=link_class)
 
     inject = delivery = None
     route = "direct"
@@ -733,12 +804,15 @@ def compile_edges(
         rounds=len(perms),
         offset_rounds=len(naive),
         lower_bound=bound,
-        predicted_cost_s=pipelined_cost_s(payload, 1, congestion),
+        predicted_cost_s=pipelined_cost_s(
+            payload, 1, congestion, link_class=link_class
+        ),
         offset_cost_s=offset_cost,
         route=route,
         inject=inject,
         delivery=delivery,
         congestion=congestion,
+        link_class=link_class,
     )
     if len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
         _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
@@ -774,6 +848,7 @@ def chunk_option(
     payload_bytes: float,
     congestions: Sequence[float],
     n_elems: Optional[int] = None,
+    link_class: str = "ici",
 ) -> Tuple[int, float]:
     """Best chunk count and its predicted cost for one round structure:
     argmin over powers of two of :func:`pipelined_cost_s`, capped so
@@ -784,10 +859,14 @@ def chunk_option(
     kmax = MAX_CHUNKS
     if n_elems is not None:
         kmax = min(kmax, max(1, int(n_elems) // CHUNK_ALIGN_ELEMS))
-    best_k, best_c = 1, pipelined_cost_s(payload_bytes, 1, congestions)
+    best_k, best_c = 1, pipelined_cost_s(
+        payload_bytes, 1, congestions, link_class=link_class
+    )
     k = 2
     while k <= kmax:
-        c = pipelined_cost_s(payload_bytes, k, congestions)
+        c = pipelined_cost_s(
+            payload_bytes, k, congestions, link_class=link_class
+        )
         if c < best_c:
             best_k, best_c = k, c
         k *= 2
@@ -799,6 +878,7 @@ def choose_chunks(
     payload_bytes: float,
     n_elems: Optional[int] = None,
     method: str = "auto",
+    link_class: str = "ici",
 ) -> int:
     """Per-payload chunk count for a compiled round structure — the
     payload-dependent half of the latency×bandwidth Pareto front.
@@ -831,9 +911,13 @@ def choose_chunks(
     _maybe_autocalibrate()
     if isinstance(compiled, CompiledEdges):
         congestions = compiled.congestion or (1.0,) * compiled.rounds
+        if link_class == "ici":
+            link_class = compiled.link_class
     else:
         congestions = (1.0,) * int(compiled)
-    k, _cost = chunk_option(payload_bytes, congestions, n_elems)
+    k, _cost = chunk_option(
+        payload_bytes, congestions, n_elems, link_class=link_class
+    )
     return k
 
 

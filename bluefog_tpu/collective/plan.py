@@ -238,6 +238,7 @@ def plan_from_matrix(
     w: np.ndarray,
     edges: Optional[Iterable[Tuple[int, int]]] = None,
     method: str = "auto",
+    link_class: str = "ici",
 ) -> CommPlan:
     """Build a plan from a combine matrix ``W`` (``W[i, j]`` = weight rank
     ``j`` applies to rank ``i``'s value; diagonal = self weights).
@@ -248,7 +249,9 @@ def plan_from_matrix(
     are packed into rounds by the comm-plan compiler (offset grouping vs
     minimal edge coloring, cost-modeled; see
     :mod:`bluefog_tpu.collective.compiler`), and the decision is recorded
-    on ``CommPlan.compile_info``.
+    on ``CommPlan.compile_info``. ``link_class`` selects the calibrated
+    alpha-beta class the compiler prices against ("ici" default; "dcn"
+    for the federation's inter-pod leg).
     """
     w = np.asarray(w, dtype=np.float64)
     size = w.shape[0]
@@ -256,7 +259,9 @@ def plan_from_matrix(
 
     if edges is None:
         edges = zip(*np.nonzero(w))
-    compiled = compiler.compile_edges(edges, size, method=method)
+    compiled = compiler.compile_edges(
+        edges, size, method=method, link_class=link_class
+    )
     rounds = []
     if compiled.delivery is not None:
         # short-cut lowering: an edge's weight applies at the round its

@@ -197,6 +197,48 @@ def region_plan(size: int, lo: int, hi: int, step: int):
     ])
 
 
+def classify_loss(detected: Sequence[int], n: int,
+                  layout=None) -> Dict[str, object]:
+    """Classify one batched detection into a loss class for the repair
+    event record (rendered as distinct classes by
+    ``tools/fleetsim_report.py`` — a whole-pod outage must not read
+    like scattered churn in the storm timeline).
+
+    - ``pod_loss``: a declared pod layout
+      (:class:`bluefog_tpu.federation.PodLayout`) and the detected set
+      covers >= 1 whole pod — ``pods_lost`` lists them.
+    - ``region_loss``: no pod knowledge, but the detected ranks form
+      one contiguous block of at least ``max(4, 2%)`` of the fleet (a
+      rack / availability-zone outage under serpentine placement).
+    - ``storm``: simultaneous scattered loss at or above the churn
+      advisory threshold.
+    - ``churn``: everything smaller.
+    """
+    ranks = sorted(int(r) for r in set(detected))
+    if not ranks:
+        return {"loss_class": "none"}
+    if layout is not None:
+        covered = set(ranks)
+        pods_lost = [
+            p for p in range(layout.n_pods)
+            if all(r in covered for r in layout.ranks(p))
+        ]
+        if pods_lost:
+            return {"loss_class": "pod_loss", "pods_lost": pods_lost}
+    block = (
+        len(ranks) >= max(4, int(n * 0.02))
+        and ranks[-1] - ranks[0] + 1 == len(ranks)
+    )
+    if block:
+        return {
+            "loss_class": "region_loss",
+            "region": [ranks[0], ranks[-1]],
+        }
+    if len(ranks) >= max(2, int(n * _CHURN_FRACTION)):
+        return {"loss_class": "storm"}
+    return {"loss_class": "churn"}
+
+
 # -- sparse repair-weight algebra ---------------------------------------------
 
 
@@ -494,13 +536,35 @@ class VirtualFleet:
     def __init__(self, n: int, topology: str = "exp2",
                  policy: str = "receiver", plan=None,
                  method: str = "neighbor_allreduce",
-                 audit_edges: bool = True, seed: int = 0):
+                 audit_edges: bool = True, seed: int = 0,
+                 edges: Optional[Dict[Tuple[int, int], float]] = None):
         from bluefog_tpu.elastic.faults import FaultPlan
         from bluefog_tpu.elastic.membership import Membership
 
         self.n = int(n)
         self.topology = topology
-        self.topo = FleetTopology(n, base_edges(n, topology, seed), policy)
+        self.topo = FleetTopology(
+            n,
+            edges if edges is not None else base_edges(n, topology, seed),
+            policy,
+        )
+        # pod layout (bluefog_tpu.federation.PodLayout) for loss-class
+        # annotation on repair events; federated fleets install a
+        # repair_hook that runs INSIDE the timed repair pass (gateway
+        # re-election) so membership + rewiring stay one event
+        self.pod_layout = None
+        self.repair_hook = None
+        if os.environ.get("BLUEFOG_PODS", "").strip():
+            try:
+                from bluefog_tpu import federation
+
+                self.pod_layout = federation.layout_from_env(self.n)
+            except ValueError:
+                warn_once(
+                    "fleetsim-pods",
+                    "BLUEFOG_PODS does not parse for a %d-rank fleet; "
+                    "repair events stay unclassified", self.n,
+                )
         self.membership = Membership(n)
         self.fault_plan = plan if plan is not None else FaultPlan()
         self.fault_plan.validate(n)
@@ -595,6 +659,12 @@ class VirtualFleet:
         for r, f in self.membership.degraded().items():
             if self.topo.degraded.get(r) != f:
                 touched += self.topo.degrade(r, f)
+        hook_detail = None
+        if self.repair_hook is not None:
+            # federated fleets re-elect gateways and rewire the
+            # inter-pod ring HERE — inside the timed window, before the
+            # single version bump, so the whole transition is one event
+            hook_detail = self.repair_hook(newly_dead, step)
         self.topo_version += 1
         self.repairs += 1
         self._degrade_dirty = False
@@ -606,7 +676,7 @@ class VirtualFleet:
             self.membership.epoch
         )
         metrics_mod.histogram("bluefog.fleetsim.event_ms").observe(ms)
-        self._record({
+        row = {
             "metric": "fleetsim_repair",
             "step": int(step),
             "detected": [int(r) for r in newly_dead],
@@ -617,7 +687,11 @@ class VirtualFleet:
             "policy": self.topo.policy,
             "touched_ranks": int(touched),
             "event_ms": round(ms, 6),
-        })
+        }
+        row.update(classify_loss(newly_dead, self.n, self.pod_layout))
+        if hook_detail:
+            row.update(hook_detail)
+        self._record(row)
         if len(newly_dead) >= max(2, int(self.n * _CHURN_FRACTION)):
             self._advise("fleet_churn", step, {
                 "killed": len(newly_dead),

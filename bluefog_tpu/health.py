@@ -1173,6 +1173,21 @@ class HealthPlane:
                 rep["shard"] = shard
         except Exception:
             pass
+        # the federated fabric rides here too: an operator reading the
+        # fleet table must see WHICH pod layout, gateway set, DCN
+        # period/wire, and predicted composed consensus rate the gossip
+        # they are looking at is actually running (BLUEFOG_PODS,
+        # docs/federation.md)
+        try:
+            from bluefog_tpu import context as ctx_mod
+            from bluefog_tpu import federation as fed_mod
+
+            if fed_mod.enabled() and ctx_mod.is_initialized():
+                fab = fed_mod.get_fabric(ctx_mod.get_context().size)
+                if fab is not None:
+                    rep["federation"] = fab.to_json()
+        except Exception:
+            pass
         # the memory observatory's summary rides the same surface: an
         # operator sizing a fleet reads per-chip footprint, headroom
         # against the budget, and the last ranked census next to the

@@ -1068,6 +1068,19 @@ class _GossipOptimizer:
                     ),
                     (),
                 )
+            if (
+                self.self_weight is None
+                and self.src_weights is None
+                and self.dst_weights is None
+            ):
+                from bluefog_tpu import federation
+
+                fed = (
+                    federation.get_fabric(ctx.size)
+                    if federation.enabled() else None
+                )
+                if fed is not None:
+                    return self._federated_key_and_fn(ctx, fed, payload)
             plan = col_ops._resolve_plan(
                 ctx,
                 self.self_weight,
@@ -1139,6 +1152,149 @@ class _GossipOptimizer:
             )
         raise AssertionError(comm)
 
+    def _federated_key_and_fn(self, ctx, fed, payload):
+        """Two-level federated dispatch (docs/federation.md): every
+        communicating step runs the intra-pod combine over ICI at full
+        rate; every ``fed.period``-th communication appends the
+        designated-gateway inter-pod combine on the aggressive DCN wire
+        in the SAME compiled body, so XLA overlaps the slow cross-pod
+        rounds with the tail of the intra-pod ones.
+
+        Key shapes (the ``"fed"`` tag is what keeps the flat path
+        bitwise-untouched — a flat run never produces one):
+
+        - ICI-only step: ``("fed", "ici", wire, perms, chunks, inject)``
+        - DCN step: ``("fed", "dcn", wire, perms, chunks, inject,
+          dcn_wire, inter_perms, inter_chunks, inter_inject)``
+
+        kernel cache tokens ride at the END (same contract as the flat
+        quantized keys). ``wire`` is the intra-pod tier
+        (``self.compression``); error-feedback tiers degrade to their
+        memoryless base because the CHOCO residual recursion assumes
+        the same combine every communicating step, which the periodic
+        DCN leg breaks.
+        """
+        from bluefog_tpu import scaling
+
+        intra = fed.intra
+        inter = fed.inter
+        self._last_plan = intra
+        flight.note_plan(intra, ctx.topo_version, ctx.live_token())
+        axis = ctx_mod.WORKER_AXIS
+        perms = intra.perms
+        info = intra.compile_info
+        inject = info.inject if info is not None else None
+        chunks = self._plan_chunks(intra, payload)
+        self_w, recv_w = intra.weight_operands()
+        wire = self.compression
+        if wire in ("int8_ef", "int4_ef"):
+            warn_once(
+                "fed-ef-wire",
+                "compression=%r under bf.federation falls back to the "
+                "memoryless %r wire: error-feedback residuals would go "
+                "stale across the BLUEFOG_DCN_PERIOD gap",
+                wire, wire[:-3],
+            )
+            wire = wire[:-3]
+        if wire is not None:
+            inner._check_combine_normalized(
+                intra, f"compression={wire!r}"
+            )
+        if not fed.dcn_step(self._comm_count):
+            if wire is not None:
+                return (
+                    ("fed", "ici", wire, perms, chunks, inject)
+                    + inner._kernels.cache_token(wire),
+                    lambda t, step, wops: (
+                        inner.weighted_combine_quantized_operands(
+                            t, perms, wops[0], axis,
+                            wire=wire, chunks=chunks, inject=inject,
+                        )
+                    ),
+                    (jnp.asarray(recv_w),),
+                )
+            return (
+                ("fed", "ici", None, perms, chunks, inject),
+                lambda t, step, wops: inner.weighted_combine_operands(
+                    t, perms, wops[0], wops[1], axis,
+                    chunks=chunks, inject=inject,
+                ),
+                (jnp.asarray(self_w), jnp.asarray(recv_w)),
+            )
+        # DCN step: the gateway leg composes AFTER the intra leg inside
+        # one fn, giving the x -> W_dcn^T (W_ici^T x) composed step the
+        # spectral scorer priced (federation.composed_rate)
+        flight.note_plan(inter, ctx.topo_version, ctx.live_token())
+        inter_perms = inter.perms
+        inter_info = inter.compile_info
+        inter_inject = (
+            inter_info.inject if inter_info is not None else None
+        )
+        inter_self, inter_recv = inter.weight_operands()
+        dcn_wire = fed.wire
+        inter_chunks = 1
+        if payload is not None and inter_info is not None:
+            payload_bytes, n_elems = payload
+            if dcn_wire is not None:
+                payload_bytes = scaling.wire_payload_bytes(
+                    n_elems, payload_bytes // max(n_elems, 1), dcn_wire
+                )
+            inter_chunks = compiler.choose_chunks(
+                inter_info, payload_bytes, n_elems=n_elems,
+                method=col_ops._plan_method(),
+            )
+        if dcn_wire is not None:
+            inner._check_combine_normalized(
+                inter, f"BLUEFOG_DCN_WIRE={dcn_wire!r}"
+            )
+        key = (
+            ("fed", "dcn", wire, perms, chunks, inject,
+             dcn_wire, inter_perms, inter_chunks, inter_inject)
+            + (inner._kernels.cache_token(wire)
+               if wire is not None else ())
+            + (inner._kernels.cache_token(dcn_wire)
+               if dcn_wire is not None else ())
+        )
+        if wire is not None:
+            n_intra = 1
+            intra_ops = (jnp.asarray(recv_w),)
+
+            def intra_leg(t, wops):
+                return inner.weighted_combine_quantized_operands(
+                    t, perms, wops[0], axis,
+                    wire=wire, chunks=chunks, inject=inject,
+                )
+        else:
+            n_intra = 2
+            intra_ops = (jnp.asarray(self_w), jnp.asarray(recv_w))
+
+            def intra_leg(t, wops):
+                return inner.weighted_combine_operands(
+                    t, perms, wops[0], wops[1], axis,
+                    chunks=chunks, inject=inject,
+                )
+        if dcn_wire is not None:
+            def fed_fn(t, step, wops):
+                return inner.weighted_combine_quantized_operands(
+                    intra_leg(t, wops), inter_perms, wops[n_intra],
+                    axis, wire=dcn_wire, chunks=inter_chunks,
+                    inject=inter_inject,
+                )
+
+            wops = intra_ops + (jnp.asarray(inter_recv),)
+        else:
+            def fed_fn(t, step, wops):
+                return inner.weighted_combine_operands(
+                    intra_leg(t, wops), inter_perms, wops[n_intra],
+                    wops[n_intra + 1], axis, chunks=inter_chunks,
+                    inject=inter_inject,
+                )
+
+            wops = intra_ops + (
+                jnp.asarray(inter_self), jnp.asarray(inter_recv),
+            )
+        return key, fed_fn, wops
+
     def _self_weight_fn(self, ctx):
         """Per-rank SELF weight of the active combine, as a traced
         ``fn(step, wops) -> scalar``, for the delayed (one-step-stale) mix.
@@ -1172,7 +1328,21 @@ class _GossipOptimizer:
                 return sw[step % sched.period, idx]
 
             return from_schedule
-        if self.compression in ("int8", "bf16", "int4"):
+        compression = self.compression
+        if compression in ("int8_ef", "int4_ef"):
+            from bluefog_tpu import federation
+
+            if (
+                self.self_weight is None
+                and self.src_weights is None
+                and self.dst_weights is None
+                and federation.enabled()
+                and federation.get_fabric(ctx.size) is not None
+            ):
+                # federated EF fallback: the dispatch degraded to the
+                # memoryless base tier, whose wops carry only recv_w
+                compression = compression[:-3]
+        if compression in ("int8", "bf16", "int4"):
             # quantized path carries only recv_w (wops[0], [rounds, size]);
             # the plan is validated normalized, so s = 1 - sum_r recv_w
             def from_recv(step, wops):
@@ -1405,7 +1575,7 @@ class _GossipOptimizer:
             )
         ef = comm_now and not hier and self.compression in (
             "int8_ef", "int4_ef",
-        ) and not self._scatter_active()
+        ) and not self._scatter_active() and gossip_key[0] != "fed"
         if ef:
             self._ensure_ef_state(ctx, params, spec, gossip_key[2])
         return (
@@ -1415,7 +1585,7 @@ class _GossipOptimizer:
 
     # -- device-tier metrics plumbing ----------------------------------------
 
-    def _metrics_wire(self, comm_now, hier):
+    def _metrics_wire(self, comm_now, hier, gossip_key=None):
         """The quantized-wire name for this dispatch's metric row, or
         None. Hierarchical compression quantizes the machine-level
         local_sum (not the packed tree payload the metric helper sees),
@@ -1423,6 +1593,10 @@ class _GossipOptimizer:
         are the ones with a well-defined per-worker payload here."""
         if not comm_now or hier or self.schedule is not None:
             return None
+        if gossip_key is not None and gossip_key[0] == "fed":
+            # federated dispatch: the key carries the EFFECTIVE intra
+            # wire (EF tiers degrade to their memoryless base there)
+            return gossip_key[2]
         if self.compression in (
             "int8", "bf16", "int8_ef", "int4", "int4_ef",
         ):
@@ -1486,11 +1660,15 @@ class _GossipOptimizer:
             tag = gossip_key[0]
             wire = None
             rounds = 0
+            ici_bytes = dcn_bytes = 0
             # gossip_key layouts: ("na", perms, chunks, inject),
             # ("na_q", wire, perms, chunks, inject),
             # ("na_q_ef", wire, perms, chunks), ("hier", perms),
             # ("hier_q", wire, perms) — perms sits at [1] except the
-            # wire-tagged quantized keys where it sits at [2]
+            # wire-tagged quantized keys where it sits at [2];
+            # ("fed", leg, wire, perms, chunks, inject[, dcn_wire,
+            # inter_perms, inter_chunks, inter_inject]) carries the
+            # intra perms at [3] and (dcn leg) inter perms at [7]
             if tag in ("na", "hier"):
                 rounds = len(gossip_key[1])
             elif tag in ("na_q", "na_q_ef", "hier_q"):
@@ -1536,6 +1714,21 @@ class _GossipOptimizer:
                     wire_bytes = int(
                         2 * (ctx.size - 1) / max(ctx.size, 1) * payload
                     )
+            elif tag == "fed":
+                # per-leg accounting: the ICI leg ships the intra-pod
+                # rounds on the optimizer's wire, the DCN leg (when this
+                # key is a DCN step) the gateway rounds on the fabric's
+                # aggressive tier
+                ici_bytes = metrics_mod.wire_bytes_per_step(
+                    by_item, len(gossip_key[3]), gossip_key[2]
+                )
+                rounds = len(gossip_key[3])
+                if gossip_key[1] == "dcn":
+                    dcn_bytes = metrics_mod.wire_bytes_per_step(
+                        by_item, len(gossip_key[7]), gossip_key[6]
+                    )
+                    rounds += len(gossip_key[7])
+                wire_bytes = ici_bytes + dcn_bytes
             else:
                 wire_bytes = metrics_mod.wire_bytes_per_step(
                     by_item, rounds, wire
@@ -1544,12 +1737,20 @@ class _GossipOptimizer:
                 # the sharded step ships the updated slices back over
                 # the fabric: price the all-gather with the gossip wire
                 wire_bytes += sharding.gather_wire_bytes(shard)
-            acct = (rounds, wire_bytes, scatter_bytes)
+            acct = (rounds, wire_bytes, scatter_bytes, ici_bytes,
+                    dcn_bytes)
             self._acct_cache[key] = acct
-        rounds, wire_bytes, scatter_bytes = acct
+        rounds, wire_bytes, scatter_bytes, ici_bytes, dcn_bytes = acct
         metrics_mod.gauge("bluefog.gossip.rounds").set(rounds)
         metrics_mod.counter("bluefog.wire_bytes").inc(wire_bytes)
         metrics_mod.counter("bluefog.comm_steps").inc()
+        if ici_bytes or dcn_bytes:
+            metrics_mod.counter(
+                "bluefog.federation.ici_wire_bytes"
+            ).inc(ici_bytes)
+            metrics_mod.counter(
+                "bluefog.federation.dcn_wire_bytes"
+            ).inc(dcn_bytes)
         if shard is not None:
             metrics_mod.gauge("bluefog.shard.enabled").set(1)
             metrics_mod.gauge("bluefog.shard.state_bytes").set(
@@ -1610,7 +1811,7 @@ class _GossipOptimizer:
         met = met_enabled and (
             self._comm_count % metrics_mod.metrics_interval() == 0
         )
-        wire_now = self._metrics_wire(comm_now, hier)
+        wire_now = self._metrics_wire(comm_now, hier, gossip_key)
         key = (
             "opt_step", self.order, self.communication_type, self._uid,
             self._tx_version, ef, cap_bytes, met,
@@ -1871,7 +2072,7 @@ class _GossipOptimizer:
             met = met_enabled and (
                 self._comm_count % metrics_mod.metrics_interval() == 0
             )
-            wire_now = self._metrics_wire(comm_now, hier)
+            wire_now = self._metrics_wire(comm_now, hier, gossip_key)
             key = (
                 "opt_fused_step", fused_uid, self.order,
                 self.communication_type, self._uid, self._tx_version, ef,

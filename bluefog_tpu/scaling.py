@@ -411,15 +411,17 @@ def plan_comm_summary(plan: CommPlan, payload_bytes: int,
     congestion = (
         info.congestion if info and info.congestion else (1.0,) * rounds
     )
+    link_class = getattr(info, "link_class", "ici") if info else "ici"
     n_elems = int(payload_bytes) // max(int(itemsize), 1)
     wire_bytes = wire_payload_bytes(n_elems, itemsize, wire)
     auto_chunks, chunked_cost = _compiler.chunk_option(
-        wire_bytes, congestion, n_elems=n_elems
+        wire_bytes, congestion, n_elems=n_elems, link_class=link_class
     )
     return {
         "rounds": rounds,
         "decomposition": info.method if info else "offset",
         "route": info.route if info else "direct",
+        "link_class": link_class,
         "naive_rounds": naive_rounds,
         "lower_bound": info.lower_bound if info else rounds,
         "wire": wire or "exact",
@@ -429,8 +431,12 @@ def plan_comm_summary(plan: CommPlan, payload_bytes: int,
         ),
         "max_congestion": max(congestion, default=1.0),
         "lineage_sidecar_bytes_per_round": LINEAGE_TAG_BYTES,
-        "predicted_cost_us": plan_cost_s(rounds, wire_bytes) * 1e6,
-        "naive_cost_us": plan_cost_s(naive_rounds, wire_bytes) * 1e6,
+        "predicted_cost_us": plan_cost_s(
+            rounds, wire_bytes, link_class=link_class
+        ) * 1e6,
+        "naive_cost_us": plan_cost_s(
+            naive_rounds, wire_bytes, link_class=link_class
+        ) * 1e6,
         "auto_chunks": auto_chunks,
         "chunked_cost_us": chunked_cost * 1e6,
     }

@@ -112,11 +112,15 @@ def declared_torus_dims(size: int) -> Optional[Tuple[int, ...]]:
 
     ``BLUEFOG_TORUS_DIMS`` names the torus the serpentine order was laid
     onto, e.g. ``4,4`` / ``4x8`` / ``16`` (a single dim = the 1-D ring).
-    Dims that do not multiply to ``size`` are ignored (a topology half
-    the slice, a CPU test mesh) — the congestion/route model then stays
+    Dims that do not multiply to ``size`` are rejected AT PARSE with a
+    one-shot warning naming the knob (a topology half the slice, a CPU
+    test mesh, a typo) — the congestion/route model then stays
     conservative (no fabric ⇒ every round is modeled congestion-free and
-    shortcut routes fall back to the virtual ring).
+    shortcut routes fall back to the virtual ring). Silently carrying a
+    mismatched fabric used to surface only deep inside route planning.
     """
+    from bluefog_tpu.logging_util import warn_once
+
     raw = os.environ.get("BLUEFOG_TORUS_DIMS", "").strip()
     if not raw:
         return None
@@ -125,13 +129,34 @@ def declared_torus_dims(size: int) -> Optional[Tuple[int, ...]]:
             int(d) for d in raw.replace("x", ",").split(",") if d.strip()
         )
     except ValueError:
+        warn_once(
+            "torus-dims-unparseable",
+            "BLUEFOG_TORUS_DIMS=%r is not a dims list (e.g. '4,8' or "
+            "'4x8'); treating the fabric as undeclared",
+            raw,
+        )
         return None
     if not dims or any(d <= 0 for d in dims):
+        warn_once(
+            "torus-dims-unparseable",
+            "BLUEFOG_TORUS_DIMS=%r is not a dims list (e.g. '4,8' or "
+            "'4x8'); treating the fabric as undeclared",
+            raw,
+        )
         return None
     n = 1
     for d in dims:
         n *= d
-    return dims if n == size else None
+    if n != size:
+        warn_once(
+            f"torus-dims-mismatch-{size}",
+            "BLUEFOG_TORUS_DIMS=%r multiplies to %d but the world has "
+            "%d ranks; treating the fabric as undeclared (routes fall "
+            "back to the virtual ring, congestion modeled 1)",
+            raw, n, size,
+        )
+        return None
+    return dims
 
 
 def serpentine_positions(dims: Sequence[int]) -> List[Tuple[int, ...]]:

@@ -1208,6 +1208,106 @@ def _check_fleetscale(lines):
     assert anchor and anchor[0]["tflops"] > 0
 
 
+def _check_federate(lines):
+    """FEDERATE_EVIDENCE.json (the committed BENCH_MODE=federate
+    output) carries the acceptance facts of the two-level ICI/DCN
+    fabric: the spectrally-chosen DCN period's predicted composed
+    consensus rate agreeing with the host-measured rate within the
+    disclosed tolerance; the >= 8x cross-pod wire-byte cut against the
+    strongest flat opponent at the matched measured rate; whole-pod
+    loss repaired as ONE event with zero stale dispatches and the
+    gateway re-election on record; and the live 2-pod dispatch whose
+    per-leg federation counters reconcile with the total — plus
+    provenance (with the per-link-class calibration echoed) and the
+    ambient anchor."""
+    _assert_provenance(lines)
+    prov = [l for l in lines if l.get("metric") == "provenance"][0]
+    classes = prov.get("calibration_link_classes", {})
+    assert {"ici", "dcn"} <= set(classes), prov
+    for cls, cal in classes.items():
+        assert cal["link_class"] == cls, cal
+        assert cal["alpha_s"] > 0 and cal["beta_bytes_per_s"] > 0, cal
+    period = [l for l in lines if l.get("metric") == "federate_period"]
+    assert period, lines
+    p = period[0]
+    assert p["met"] is True
+    assert p["abs_err"] <= p["tolerance"], p
+    assert any(
+        row["period"] == p["chosen_period"] for row in p["table"]
+    ), p
+    assert p["predicted_rate"] <= p["target_rate"], p
+    wire = [l for l in lines if l.get("metric") == "federate_wire"]
+    assert wire, lines
+    w = wire[0]
+    assert w["dcn_cut_ratio_matched"] >= 8.0, w
+    # the flat opponent must really be at least as strong at the
+    # matched cadence — otherwise the cut ratio compares against a
+    # weaker consensus contract
+    assert (
+        w["measured_rate_flat_matched"]
+        <= w["measured_rate_fed"] + 1e-6
+    ), w
+    assert w["flat_gossip_every"] >= 1, w
+    pod = [l for l in lines if l.get("metric") == "federate_podloss"]
+    assert pod, lines
+    pl = pod[0]
+    assert pl["repair_events"] == 1, pl
+    assert pl["stale_dispatches"] == 0, pl
+    assert pl["loss_class"] == "pod_loss", pl
+    assert pl["pods_lost"] == [pl["pod_lost"]], pl
+    assert pl["live_after"] == pl["n"] - pl["ranks_lost"], pl
+    disp = [l for l in lines if l.get("metric") == "federate_dispatch"]
+    assert disp, lines
+    d = disp[0]
+    assert d["ici_wire_bytes"] > 0 and d["dcn_wire_bytes"] > 0, d
+    assert d["total_wire_bytes"] == (
+        d["ici_wire_bytes"] + d["dcn_wire_bytes"]
+    ), d
+    assert d["mean_preserved"] is True, d
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
+def test_bench_diff_federate_columns_are_tooling_gained(tmp_path):
+    """The federation evidence columns (composed-rate predictions,
+    per-leg byte totals, matched-rate cut ratios) against a
+    pre-federation artifact must read as tooling-gained
+    (FEDERATE_DERIVED), never a comparability break."""
+    sys.path.insert(0, REPO)
+    from tools.bench_diff import compare, FEDERATE_DERIVED, TOOLING_DERIVED
+
+    assert FEDERATE_DERIVED <= TOOLING_DERIVED
+
+    prov = {
+        "metric": "provenance", "jax": "1", "jaxlib": "1",
+        "cpu_model": "x", "timing_method": "t", "git_sha": "a",
+    }
+
+    def artifact(path, with_federate):
+        rows = [prov, {
+            "metric": "health_decay", "topology": "ring",
+            "n_workers": 8, "predicted_rate": 0.8,
+        }]
+        if with_federate:
+            rows.append({
+                "metric": "federate_wire", "n": 16,
+                "dcn_cut_ratio_matched": 39.7,
+                "fed_dcn_bytes_per_step": 132096.0,
+            })
+        path.write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n"
+        )
+        return str(path)
+
+    old = artifact(tmp_path / "old.json", False)
+    new = artifact(tmp_path / "new.json", True)
+    rep = compare(old, new, [])
+    assert not rep["comparability_problems"], rep
+    cell = [c for c in rep["cells"] if c["status"] == "paired"][0]
+    assert not cell.get("harness_change"), cell
+    assert cell["verdict"].startswith("comparable"), cell
+
+
 def test_bench_diff_fleetscale_columns_are_tooling_gained(tmp_path):
     """The fleet-scale evidence columns (event costs, exponent fits,
     decision latency) against a pre-fleetsim artifact must read as
@@ -1268,6 +1368,7 @@ EVIDENCE_CHECKS = {
     "SHARD_EVIDENCE.json": _check_shard,
     "MEMORY_EVIDENCE.json": _check_memory,
     "FLEETSCALE_EVIDENCE.json": _check_fleetscale,
+    "FEDERATE_EVIDENCE.json": _check_federate,
 }
 
 

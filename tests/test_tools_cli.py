@@ -83,7 +83,8 @@ def test_tools_enumerated():
     names = {os.path.basename(t) for t in TOOLS}
     assert {
         "autotune_report.py", "bench_diff.py", "doctor.py",
-        "fleet_report.py", "fleetsim_report.py", "memory_report.py",
+        "federation_report.py", "fleet_report.py",
+        "fleetsim_report.py", "memory_report.py",
         "metrics_report.py",
         "shard_plan.py", "staleness_report.py", "trace_merge.py",
         "hlo_overlap_scan.py", "hlo_dump.py", "perf_probe.py",

@@ -177,11 +177,28 @@ FLEETSCALE_DERIVED = {
     "worst_abs_diff",
 }
 
+# Federation columns that arrived with the federate evidence family
+# (BENCH_MODE=federate): composed consensus-rate predictions vs host
+# measurements, per-leg wire-byte totals, matched-rate cut ratios and
+# pod-loss repair bookkeeping are control-plane/accounting readings
+# derived from the two-level fabric (the one device leg reads counters,
+# not timings), so their one-sided appearance against a pre-federation
+# artifact is the tooling gaining a column — never a comparability
+# break.
+FEDERATE_DERIVED = {
+    "predicted_rate", "measured_rate", "abs_err", "chosen_period",
+    "dcn_cut_ratio_matched", "fed_dcn_bytes_per_step",
+    "flat_dcn_bytes_per_step_matched", "ici_wire_bytes_per_step",
+    "ici_wire_bytes", "dcn_wire_bytes", "consensus_spread",
+    "measured_rate_fed", "measured_rate_flat_dense",
+    "measured_rate_flat_matched",
+}
+
 # Every one-sided-tolerated derived column set.
 TOOLING_DERIVED = (
     ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
     | ASYNC_DERIVED | SHARD_DERIVED | MEMORY_DERIVED
-    | WIRE_KERNEL_DERIVED | FLEETSCALE_DERIVED
+    | WIRE_KERNEL_DERIVED | FLEETSCALE_DERIVED | FEDERATE_DERIVED
 )
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")

@@ -12,8 +12,10 @@ either — or both — into the storm timeline an operator reads first:
   the N sweep, growth exponent, dense-baseline extrapolation with its
   disclosed model),
 - the **storm timeline** (step-ordered repairs with detected ranks,
-  survivor count, epoch, topology version, per-event cost; advisories
-  inline; the worst event flagged),
+  survivor count, epoch, topology version, per-event cost; whole-pod /
+  whole-region outages rendered as their own loss class with the
+  gateway re-election inline; advisories inline; the worst event
+  flagged),
 - the **decision block** (controller candidates, chosen topology,
   measured decision latency),
 - the headline verdict line: stale dispatches (must be 0), repairs,
@@ -95,6 +97,23 @@ def build_report(rows: List[dict]) -> dict:
     }
 
 
+def _loss_label(r: dict) -> str:
+    """A whole-pod or whole-region outage must read as its own class in
+    the storm timeline — a 16-rank pod loss is operationally one event
+    (gateway re-election, inter-pod renormalization), not 16 lines of
+    scattered churn (``bluefog_tpu.fleetsim.classify_loss``)."""
+    cls = r.get("loss_class")
+    if cls == "pod_loss":
+        return f"  [POD LOSS: pods {r.get('pods_lost')}]"
+    if cls == "region_loss":
+        region = r.get("region")
+        span = f" ranks {region[0]}-{region[1]}" if region else ""
+        return f"  [REGION LOSS:{span}]"
+    if cls == "storm":
+        return "  [storm]"
+    return ""
+
+
 def render(report: dict) -> str:
     out = []
     scaling = report["scaling"]
@@ -135,8 +154,12 @@ def render(report: dict) -> str:
                 f"step {r['step']:>6}: -{len(r.get('detected', []))} "
                 f"ranks, live={r['live']}, epoch={r['epoch']}, "
                 f"topo v{r['topo_version']}, {r['event_ms']:.4f} ms"
-                f"{flag}"
+                f"{_loss_label(r)}{flag}"
             )
+            if r.get("gateway_change"):
+                out.append(
+                    f"        gateways re-elected: {r.get('gateways')}"
+                )
         out.append("")
     for r in report["rejoins"]:
         out.append(f"step {r['step']:>6}: rank {r['rank']} rejoined, "
