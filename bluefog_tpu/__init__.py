@@ -99,6 +99,7 @@ from bluefog_tpu import memory
 from bluefog_tpu import fleetsim
 from bluefog_tpu import federation
 from bluefog_tpu import sharding
+from bluefog_tpu import slo
 from bluefog_tpu import staleness
 from bluefog_tpu import metrics
 from bluefog_tpu.metrics import (
@@ -356,6 +357,7 @@ __all__ = [
     "memory",
     "fleetsim",
     "federation",
+    "slo",
     "staleness",
     "metrics",
     "metrics_snapshot",

@@ -450,6 +450,12 @@ class DecisionRecord:
     # different candidate pools AND different cost models, so their
     # decisions must be attributable separately in the audit trail)
     level: str = "flat"
+    # the worst fast-window SLO burn rate (bluefog_tpu.slo) at
+    # decision time: the controller's audit trail must show whether a
+    # swap was chosen while the fleet was actively burning its error
+    # budget — a topology gamble under budget pressure reads
+    # differently in a postmortem than the same gamble while green
+    slo_burn: float = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -470,6 +476,7 @@ class DecisionRecord:
             "async_mode": self.async_mode,
             "memory_pressure": self.memory_pressure,
             "level": self.level,
+            "slo_burn": self.slo_burn,
         }
 
 
@@ -482,6 +489,18 @@ def _async_mode() -> bool:
         return async_gossip.active() is not None
     except Exception:
         return False
+
+
+def _slo_burn() -> float:
+    """Worst fast-window SLO burn rate at decision time (0.0 when the
+    SLO engine is off) — decision records carry it so the audit trail
+    shows which choices were made under budget pressure."""
+    try:
+        from bluefog_tpu import slo as slo_mod
+
+        return float(slo_mod.worst_burn())
+    except Exception:
+        return 0.0
 
 
 def _memory_pressure() -> bool:
@@ -1131,6 +1150,7 @@ class TopologyAutotuner:
             async_mode=_async_mode(),
             memory_pressure=_memory_pressure(),
             level=_search_level(ctx),
+            slo_burn=_slo_burn(),
         )
         self._emit(record)
         return record
@@ -1251,6 +1271,7 @@ class TopologyAutotuner:
                 async_mode=_async_mode(),
                 memory_pressure=_memory_pressure(),
                 level=_search_level(ctx),
+                slo_burn=_slo_burn(),
             )
             self._emit_verification(verdict)
             self._emit(record)

@@ -413,6 +413,13 @@ def init(
     from bluefog_tpu import async_gossip as _async_gossip
 
     _async_gossip.on_init(_context)
+    # SLO engine (BLUEFOG_SLO=1): fresh session per mesh — a new mesh
+    # must not inherit a torn-down mesh's error-budget history.
+    # Installed LAST among the observatories: its sampled pass reads
+    # the series every tier above publishes.
+    from bluefog_tpu import slo as _slo
+
+    _slo.on_init(_context)
     # Mesh-shape gauges: every metrics export carries the context the
     # series were recorded under (a JSONL file divorced from its run is
     # otherwise uninterpretable).
@@ -440,8 +447,13 @@ def shutdown() -> None:
     from bluefog_tpu import async_gossip as _async_gossip
 
     _elastic.stop()
-    # the controller goes first: its session_end summary must flush
-    # while the surfaces it writes through are still up
+    # the SLO engine goes first: its budget tail must flush while the
+    # tiers it reads (and the surfaces it writes through) are still up
+    from bluefog_tpu import slo as _slo
+
+    _slo.on_shutdown()
+    # then the controller: its session_end summary must flush while
+    # the surfaces it writes through are still up
     _autotune.on_shutdown()
     _async_gossip.on_shutdown()
     _attribution.on_shutdown()

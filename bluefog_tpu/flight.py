@@ -174,6 +174,11 @@ _decisions: List[dict] = []  # bounded side table of autotune decisions
 # controller changed mid-flight must carry WHY — the swap/rollback
 # history survives ring eviction exactly like the advisories that
 # triggered it
+_slo: List[dict] = []  # bounded side table of SLO budget snapshots
+# (bluefog_tpu.slo): a crash dump must carry the burn-rate and
+# error-budget state that preceded it — "we died while paging on a
+# burned budget" vs "we died green" is the first postmortem question
+# — so the sampled snapshots survive ring eviction like the rest
 _plans_lock = threading.Lock()
 _hooks_installed = False
 _prev_excepthook = None
@@ -229,6 +234,7 @@ def reconfigure() -> None:
         _faults.clear()
         _advisories.clear()
         _decisions.clear()
+        _slo.clear()
     del _dump_history[:]
 
 
@@ -332,6 +338,20 @@ def note_decision(**data) -> None:
     record("autotune", **data)
 
 
+def note_slo(**data) -> None:
+    """Record an SLO budget snapshot (:mod:`bluefog_tpu.slo`) in BOTH
+    the ring and a bounded side table, mirroring
+    :func:`note_decision`: the postmortem must read the worst burn
+    rate and exhausted-objective set leading into a crash even after
+    the ring evicts the samples."""
+    if not enabled():
+        return
+    with _plans_lock:
+        _slo.append(dict(data))
+        del _slo[:-64]
+    record("slo", **data)
+
+
 def _clock_triple() -> dict:
     """The cross-rank alignment anchor: the same instant on all three
     clocks this process emits timestamps in — wall (shared across
@@ -412,6 +432,7 @@ def _build_dump(reason: str) -> dict:
         out["fault_events"] = list(_faults)
         out["advisories"] = list(_advisories)
         out["autotune_decisions"] = list(_decisions)
+        out["slo_snapshots"] = list(_slo)
     try:
         out["metrics"] = metrics_mod.snapshot()
     except Exception:

@@ -149,6 +149,10 @@ FLEET_FIELDS = (
     "mem_headroom",        # budget minus footprint (0 when no
     #                        BLUEFOG_MEMORY_BUDGET is configured) —
     #                        the fleet min is the chip closest to OOM
+    "slo_burn",            # worst fast-window SLO burn rate at this
+    #                        rank (bluefog_tpu.slo; 0 when the engine
+    #                        is off) — the fleet MAX is the rank
+    #                        burning its error budget fastest
 )
 
 
@@ -734,6 +738,7 @@ class HealthPlane:
         mem_bytes, mem_headroom = self._memory_fields()
         vec[:, 6] = mem_bytes
         vec[:, 7] = mem_headroom
+        vec[:, 8] = self._slo_burn()
         return vec
 
     @staticmethod
@@ -752,6 +757,19 @@ class HealthPlane:
                     float(obs.last_headroom()))
         except Exception:
             return 0.0, 0.0
+
+    @staticmethod
+    def _slo_burn() -> float:
+        """Worst fast-window burn rate this controller's SLO engine
+        reports (0.0 when the engine is off) — aggregated fleet-wide
+        min/mean/max over the push-sum lane: the fleet MAX names the
+        rank burning its error budget fastest."""
+        try:
+            from bluefog_tpu import slo as slo_mod
+
+            return float(slo_mod.worst_burn())
+        except Exception:
+            return 0.0
 
     @staticmethod
     def _staleness_age_max() -> float:
@@ -1211,6 +1229,27 @@ class HealthPlane:
                 }
         except Exception:
             pass
+        # the SLO engine's budget summary rides the same surface: the
+        # operator reading the fleet table needs "how much failure
+        # budget is left and how fast is it burning" next to the raw
+        # numbers that spend it (BLUEFOG_SLO, docs/slo.md); the full
+        # artifact is served at /slo
+        try:
+            from bluefog_tpu import slo as slo_mod
+
+            eng = slo_mod.active()
+            if eng is not None:
+                rep["slo"] = {
+                    "worst_burn": eng.worst_burn(),
+                    "exhausted": eng.exhausted_objectives(),
+                    "alerts": len(eng.alerts),
+                    "canary": (
+                        eng.canary.last
+                        if eng.canary is not None else None
+                    ),
+                }
+        except Exception:
+            pass
         return rep
 
     def dump(self, path: str) -> str:
@@ -1226,7 +1265,10 @@ def healthz_verdict(plane: Optional["HealthPlane"] = None) -> dict:
     """The ``/healthz`` RAG verdict, computable without a live mesh:
 
     - **critical** — the elastic membership holds dead or suspect
-      ranks (the run is mid-failure or down a worker);
+      ranks (the run is mid-failure or down a worker), or an SLO
+      error budget is exhausted (:mod:`bluefog_tpu.slo` — a spent
+      budget is the contract-level outage even while every rank
+      answers its heartbeat);
     - **warn** — any advisory (health or doctor) fired within the last
       :data:`VERDICT_RECENT_SAMPLES` health samples;
     - **ok** — otherwise.
@@ -1259,6 +1301,16 @@ def healthz_verdict(plane: Optional["HealthPlane"] = None) -> dict:
         if suspects:
             status = "critical"
             reasons.append(f"suspect ranks: {suspects}")
+    exhausted: List[str] = []
+    try:
+        from bluefog_tpu import slo as slo_mod
+
+        exhausted = slo_mod.exhausted_objectives()
+    except Exception:
+        pass
+    if exhausted:
+        status = "critical"
+        reasons.append(f"slo budget exhausted: {exhausted}")
     recent: List[dict] = []
     if plane is not None:
         floor = plane._count - VERDICT_RECENT_SAMPLES * plane.interval
@@ -1296,6 +1348,7 @@ def healthz_verdict(plane: Optional["HealthPlane"] = None) -> dict:
         "reasons": reasons,
         "dead_ranks": dead,
         "suspect_ranks": suspects,
+        "slo_exhausted": exhausted,
         "recent_advisories": recent[-8:],
         "ts": time.time(),
     }
@@ -1378,11 +1431,24 @@ class HealthServer:
                         self._send(200, json.dumps(
                             _json_safe(body), allow_nan=False
                         ))
+                    elif path == "/slo":
+                        from bluefog_tpu import slo as slo_mod
+
+                        eng = slo_mod.active()
+                        body = (
+                            eng.report() if eng is not None
+                            else {"kind": "slo_dump",
+                                  "objectives": [], "alerts": [],
+                                  "canary": None}
+                        )
+                        self._send(200, json.dumps(
+                            _json_safe(body), allow_nan=False
+                        ))
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "paths": ["/healthz", "/metrics",
-                                       "/fleet"]}
+                                       "/fleet", "/slo"]}
                         ))
                 except Exception as e:  # a scrape bug must not hang curl
                     try:

@@ -63,6 +63,7 @@ from bluefog_tpu import sharding
 from bluefog_tpu import health as health_mod
 from bluefog_tpu import memory as memory_mod
 from bluefog_tpu import metrics as metrics_mod
+from bluefog_tpu import slo as slo_mod
 from bluefog_tpu import staleness as staleness_mod
 from bluefog_tpu import timeline as tl
 from bluefog_tpu import windows as win_mod
@@ -1926,6 +1927,15 @@ class _GossipOptimizer:
                 ctx, step=self._step_count - 1, optimizer=self,
                 params=params_out, opt_state=opt_state, grads=grads,
             )
+            # SLO engine (BLUEFOG_SLO): evaluates LAST so its sampled
+            # pass reads the gauges the tiers above just refreshed;
+            # its canary probe dispatches in its own op-cache family —
+            # the training program above is untouched (same cache
+            # key, bitwise pin)
+            slo_mod.observe_step(
+                ctx, step=self._step_count - 1, plan=self._last_plan,
+                wire=self.compression,
+            )
         if ef:
             self._ef = ef_out
         elif scatter_ef:
@@ -2341,6 +2351,13 @@ class _GossipOptimizer:
                 memory_mod.observe_step(
                     ctx, step=self._step_count - 1, optimizer=self,
                     params=params_o, opt_state=state_o,
+                )
+                # SLO engine: last, same discipline as the two-program
+                # path — reads the tiers above, canary in its own
+                # op-cache family, training program untouched
+                slo_mod.observe_step(
+                    ctx, step=self._step_count - 1,
+                    plan=self._last_plan, wire=self.compression,
                 )
                 if delay_now:
                     # the dispatch above refilled the double buffer

@@ -1,0 +1,91 @@
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Advisory-kind drift guard (the tests/test_metric_names.py
+discipline applied to the doctor taxonomy): every advisory kind the
+package can emit must have a row in the docs/doctor.md advisory
+taxonomy table, and every table row must correspond to a kind the
+code actually raises. An operator paging off the documented taxonomy
+must never meet an undocumented advisory — or hunt for one that can
+no longer fire.
+
+Extraction is static, over the package's uniform emission idioms:
+
+- ``Advisory(kind="<kind>", ...)`` and positional
+  ``Advisory("<kind>", ...)`` constructions;
+- the ``self._advise("<kind>", ...)`` helpers (memory, fleetsim);
+- ``note_advisory(kind="<kind>", ...)`` literal-kind calls;
+- the ``_ADVISORY_KINDS`` registry tuple in attribution.py.
+"""
+
+import glob
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "bluefog_tpu")
+DOC = os.path.join(REPO, "docs", "doctor.md")
+
+# Advisory(kind="x" / Advisory("x" — tolerate a line break between the
+# call paren and the kind argument (black-style wrapped calls)
+_CONSTRUCT_RE = re.compile(
+    r'Advisory\(\s*(?:kind=)?"([a-z_]+)"', re.S
+)
+_ADVISE_RE = re.compile(r'_advise\(\s*"([a-z_]+)"', re.S)
+_NOTE_RE = re.compile(r'note_advisory\(\s*kind="([a-z_]+)"', re.S)
+_REGISTRY_RE = re.compile(r"_ADVISORY_KINDS\s*=\s*\(([^)]*)\)", re.S)
+
+
+def _code_kinds():
+    kinds = set()
+    for path in glob.glob(PKG + "/**/*.py", recursive=True):
+        with open(path) as f:
+            src = f.read()
+        for rx in (_CONSTRUCT_RE, _ADVISE_RE, _NOTE_RE):
+            kinds.update(rx.findall(src))
+        for m in _REGISTRY_RE.finditer(src):
+            kinds.update(re.findall(r'"([a-z_]+)"', m.group(1)))
+    return kinds
+
+
+def _doc_kinds():
+    text = open(DOC).read()
+    m = re.search(
+        r"<!-- advisory-taxonomy:begin -->(.*?)"
+        r"<!-- advisory-taxonomy:end -->",
+        text, re.S,
+    )
+    assert m, "docs/doctor.md lost its advisory-taxonomy markers"
+    kinds = set()
+    for row in re.finditer(r"^\|\s*`([a-z_]+)", m.group(1), re.M):
+        kinds.add(row.group(1))
+    assert kinds, "advisory taxonomy table is empty"
+    return kinds
+
+
+def test_every_emitted_advisory_is_documented():
+    code, docs = _code_kinds(), _doc_kinds()
+    undocumented = sorted(code - docs)
+    assert not undocumented, (
+        "advisory kinds raised in bluefog_tpu/ but missing from the "
+        f"docs/doctor.md taxonomy table: {undocumented}"
+    )
+
+
+def test_every_documented_advisory_is_emitted():
+    code, docs = _code_kinds(), _doc_kinds()
+    phantom = sorted(docs - code)
+    assert not phantom, (
+        "docs/doctor.md taxonomy rows with no raising code in "
+        f"bluefog_tpu/: {phantom}"
+    )
+
+
+def test_guard_extraction_sees_known_anchors():
+    """The guard must be looking at real data: one kind from each
+    emission idiom must surface."""
+    code = _code_kinds()
+    assert "degraded_link" in code        # registry tuple + kw ctor
+    assert "slo_fast_burn" in code        # positional ctor (slo.py)
+    assert "memory_drift" in code         # _advise helper
+    assert "oom" in code                  # note_advisory literal
+    assert "fleet_churn" in code          # fleetsim _advise
+    assert "async_staleness" in code      # wrapped-kw ctor

@@ -1347,6 +1347,55 @@ def test_bench_diff_fleetscale_columns_are_tooling_gained(tmp_path):
     assert cell["verdict"].startswith("comparable"), cell
 
 
+def _check_slo(lines):
+    """SLO_EVIDENCE.json (the committed BENCH_MODE=slo output) carries
+    the acceptance facts: the fault paging within the documented
+    sample bound with a clean A/A, the slow-window/fast-window/hygiene
+    separation on the ramp, the canary naming exactly the injected
+    edge, sampled-SLO overhead <=1% with the A/A control and the
+    structural + bitwise pins, and the N=1024 churn-storm burn math
+    exact against the numpy oracle — plus provenance and the ambient
+    anchor."""
+    _assert_provenance(lines)
+    page = [l for l in lines if l.get("metric") == "slo_page_bound"]
+    assert page, lines
+    assert page[0]["paged_within_bound"] is True
+    assert page[0]["samples_to_page"] <= page[0]["page_sample_bound"]
+    assert page[0]["warmup_false_alarms"] == 0
+    assert page[0]["aa_false_alarms"] == 0
+    assert page[0]["aa_steps"] >= 500
+    ramp = [l for l in lines if l.get("metric") == "slo_slow_ramp"]
+    assert ramp, lines
+    assert ramp[0]["slow_window_fired"] is True
+    assert ramp[0]["fast_window_silent"] is True
+    assert ramp[0]["hygiene_streak_armed"] is False
+    canary = [l for l in lines if l.get("metric") == "slo_canary"]
+    assert canary, lines
+    assert canary[0]["probe_elems"] == 512
+    assert canary[0]["clean_ok"] is True
+    assert canary[0]["clean_max_dev"] <= canary[0]["tolerance"]
+    assert canary[0]["lossy_ok"] is False
+    assert canary[0]["named_correctly"] is True
+    assert canary[0]["injected_edge"] in canary[0]["edges_named"]
+    overhead = [l for l in lines if l.get("metric") == "slo_overhead"]
+    assert overhead, lines
+    assert overhead[0]["overhead_pct"] <= 1.0
+    assert "control_aa_pct" in overhead[0]
+    assert overhead[0]["unsampled_program_shared"] is True
+    assert overhead[0]["bitwise_identical"] is True
+    assert overhead[0]["canary_programs"] >= 1
+    storm = [l for l in lines if l.get("metric") == "slo_fleet_storm"]
+    assert storm, lines
+    assert storm[0]["fleet_n"] >= 1024
+    assert storm[0]["max_burn_err_vs_oracle"] == 0.0
+    assert storm[0]["max_budget_err_vs_oracle"] == 0.0
+    assert storm[0]["paged_within_bound"] is True
+    catalog = [l for l in lines if l.get("metric") == "slo_catalog"]
+    assert catalog and len(catalog[0]["objectives"]) >= 8
+    anchor = [l for l in lines if l.get("metric") == "ambient_anchor"]
+    assert anchor and anchor[0]["tflops"] > 0
+
+
 # -- the committed-evidence sweep ---------------------------------------------
 #
 # One parametrized test over EVERY committed evidence artifact: each
@@ -1362,6 +1411,7 @@ EVIDENCE_CHECKS = {
     "ATTRIBUTION_EVIDENCE.json": _check_attribution,
     "QUANT_EVIDENCE.json": _check_quant,
     "HEALTH_EVIDENCE.json": _check_health,
+    "SLO_EVIDENCE.json": _check_slo,
     "AUTOTUNE_EVIDENCE.json": _check_autotune,
     "ASYNC_EVIDENCE.json": _check_async,
     "STALENESS_EVIDENCE.json": _check_staleness,

@@ -115,6 +115,39 @@ def test_histogram_log_bucket_quantiles():
     assert "p50" not in empty.describe()
 
 
+def test_histogram_quantile_clamp_boundary_read_only():
+    """Satellite: ``Histogram.quantile`` at the 321-bucket clamp
+    boundary — observations beyond the 2**±40 index range share the
+    edge buckets yet every reported quantile stays inside the exact
+    observed [min, max] envelope — and the read is PURE: quantile()
+    mutates no exporter state (the SLO engine's p99 reads must never
+    perturb a scrape)."""
+    h = metrics.Histogram()
+    # both sides of the clamp: overflow bucket (2**40 and far beyond
+    # alias to _IDX_MAX) and underflow (<= 2**-40 and zero/negative)
+    for v in (2.0 ** 41, 1e13, 3e13, 2.0 ** -41, 1e-13, 0.0):
+        h.observe(v)
+    assert len(h._buckets) <= 2  # everything clamped to the two edges
+    lo, hi = h.min, h.max
+    for q in (0.01, 0.5, 0.99, 1.0):
+        v = h.quantile(q)
+        assert lo <= v <= hi, (q, v)
+    # the overflow bucket's representative (2**40) is BELOW the true
+    # max — the envelope clamp is what keeps p99 honest out there
+    assert h.quantile(0.99) <= hi
+    before = (h.count, h.sum, h.min, h.max, h.last, dict(h._buckets))
+    desc_before = h.describe()
+    for q in (0.5, 0.99):
+        h.quantile(q)
+    assert (h.count, h.sum, h.min, h.max, h.last,
+            dict(h._buckets)) == before
+    assert h.describe() == desc_before
+    # and a registry-level read through peek() creates nothing
+    metrics.reset()
+    assert metrics.peek("bluefog.slo.never_written") is None
+    assert metrics.snapshot() == {}
+
+
 def test_prom_export_deterministic_with_help_and_quantiles(tmp_path):
     """Satellite: successive scrapes of an unchanged registry are
     byte-identical (deterministic series ordering) and every family

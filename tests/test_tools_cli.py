@@ -86,7 +86,8 @@ def test_tools_enumerated():
         "federation_report.py", "fleet_report.py",
         "fleetsim_report.py", "memory_report.py",
         "metrics_report.py",
-        "shard_plan.py", "staleness_report.py", "trace_merge.py",
+        "shard_plan.py", "slo_report.py", "staleness_report.py",
+        "trace_merge.py",
         "hlo_overlap_scan.py", "hlo_dump.py", "perf_probe.py",
         "resnet_layer_profile.py", "transformer_stage_profile.py",
     } <= names

@@ -194,11 +194,27 @@ FEDERATE_DERIVED = {
     "measured_rate_flat_matched",
 }
 
+# SLO-engine columns that arrived with the slo evidence family
+# (BENCH_MODE=slo): burn rates, error-budget accounts, page-bound
+# arithmetic and canary deviation readings are budget bookkeeping
+# derived from sampled flags (the one timed reading, the overhead
+# rotation, carries its own A/A control), so their one-sided
+# appearance against a pre-slo artifact is the tooling gaining a
+# column — never a timing-harness change.
+SLO_DERIVED = {
+    "page_sample_bound", "samples_to_page", "aa_false_alarms",
+    "hygiene_max_abs_z", "bad_samples", "clean_max_dev",
+    "lossy_max_dev", "max_burn_err_vs_oracle",
+    "max_budget_err_vs_oracle", "slo_overhead_pct", "worst_burn",
+    "budget_remaining", "canary_programs",
+}
+
 # Every one-sided-tolerated derived column set.
 TOOLING_DERIVED = (
     ANCHOR_DERIVED | WIRE_DERIVED | HEALTH_DERIVED | AUTOTUNE_DERIVED
     | ASYNC_DERIVED | SHARD_DERIVED | MEMORY_DERIVED
     | WIRE_KERNEL_DERIVED | FLEETSCALE_DERIVED | FEDERATE_DERIVED
+    | SLO_DERIVED
 )
 
 PROVENANCE_COMPARE = ("jax", "jaxlib", "cpu_model", "timing_method")

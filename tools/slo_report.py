@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+# Copyright 2026. Licensed under the Apache License, Version 2.0.
+"""Triage an SLO-engine artifact into the budget table an operator
+reads first.
+
+The SLO engine (:mod:`bluefog_tpu.slo`, docs/slo.md) leaves one
+artifact per controller process — ``bf.slo.dump(path)`` JSON and/or
+the ``BLUEFOG_SLO_FILE`` JSONL — carrying per-objective error-budget
+accounts, multi-window burn rates, every burn/exhaustion alert, and
+the canary lane's edge verdicts. This tool joins them into: the
+budget table (spent / remaining / compliance, worst first), the burn
+timeline, the alert history by severity, and the canary verdict with
+its failing edges.
+
+Usage::
+
+    python tools/slo_report.py slo_dump.json
+    python tools/slo_report.py --jsonl slo.jsonl
+    python tools/slo_report.py ... --json
+
+No jax import, no live mesh needed. Exit status 0 on a parseable
+input set, 2 when nothing could be read.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+# page-severity kinds outrank ticket-severity in the one-line triage
+ALERT_PRIORITY = (
+    "slo_budget_exhausted", "slo_canary_failed", "slo_fast_burn",
+    "slo_slow_burn",
+)
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("kind") != "slo_dump":
+        raise ValueError(
+            f"{path} is not an SLO artifact (expected kind="
+            f"'slo_dump', got {d.get('kind')!r})"
+        )
+    return d
+
+
+def load_jsonl(path: str) -> dict:
+    """Rebuild a dump-shaped dict from the BLUEFOG_SLO_FILE stream
+    (samples + advisories, one JSON object per line)."""
+    samples: List[dict] = []
+    alerts: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("kind") == "sample":
+                samples.append(obj)
+            elif obj.get("kind") == "advisory":
+                alerts.append(obj)
+    # last known per-objective state from the sample stream
+    objectives: dict = {}
+    canary_last = None
+    for s in samples:
+        for name, rec in (s.get("objectives") or {}).items():
+            cur = objectives.setdefault(name, {
+                "name": name, "samples": 0, "alerts": 0,
+                "burn_fast": None, "burn_slow": None,
+                "budget": {"remaining": None},
+            })
+            cur["samples"] += 1
+            cur["last_value"] = rec.get("value")
+            cur["burn_fast"] = rec.get("burn_fast")
+            cur["burn_slow"] = rec.get("burn_slow")
+            cur["budget"] = {"remaining": rec.get("budget_remaining")}
+        if s.get("canary") is not None:
+            canary_last = s["canary"]
+    return {
+        "kind": "slo_dump",
+        "samples": samples,
+        "alerts": alerts,
+        "objectives": list(objectives.values()),
+        "canary": (
+            {"last": canary_last} if canary_last is not None else None
+        ),
+        "comm_steps": max(
+            (s.get("comm_steps", 0) for s in samples), default=0
+        ),
+    }
+
+
+def build_report(dump: dict) -> dict:
+    objectives = dump.get("objectives") or []
+    alerts = dump.get("alerts") or []
+    samples = dump.get("samples") or []
+    by_kind: dict = {}
+    for a in alerts:
+        # dump-file alerts carry the kind at top level
+        # (Advisory.to_json); JSONL stream lines carry
+        # kind='advisory' with the real kind under 'advisory_kind'
+        kind = a.get("advisory_kind") or a.get("kind")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    worst_alert = next(
+        (k for k in ALERT_PRIORITY if by_kind.get(k)), None
+    )
+    burn_timeline = [
+        {"step": s.get("step"), "worst_burn": s.get("worst_burn")}
+        for s in samples if s.get("worst_burn") is not None
+    ]
+    exhausted = [
+        o["name"] for o in objectives
+        if (o.get("budget") or {}).get("exhausted")
+    ]
+
+    def spent_frac(o):
+        b = o.get("budget") or {}
+        total = b.get("total") or 0
+        return (b.get("spent") or 0) / total if total else 0.0
+
+    return {
+        "kind": "slo_report",
+        "comm_steps": dump.get("comm_steps"),
+        "interval": dump.get("interval"),
+        "worst_burn": dump.get("worst_burn"),
+        "objectives": sorted(objectives, key=spent_frac,
+                             reverse=True),
+        "exhausted": exhausted,
+        "alerts": len(alerts),
+        "alerts_by_kind": by_kind,
+        "worst_alert": worst_alert,
+        "burn_timeline": burn_timeline[-64:],
+        "canary": dump.get("canary"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifacts", nargs="*",
+                    help="SLO artifact JSON files "
+                         "(bf.slo.dump output)")
+    ap.add_argument("--jsonl",
+                    help="BLUEFOG_SLO_FILE stream to rebuild a "
+                         "report from")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    dumps: List[dict] = []
+    for p in args.artifacts:
+        try:
+            dumps.append(load_artifact(p))
+        except (OSError, ValueError) as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if args.jsonl:
+        try:
+            dumps.append(load_jsonl(args.jsonl))
+        except OSError as e:
+            print(f"warning: {e}", file=sys.stderr)
+    if not dumps:
+        print("no readable SLO artifacts given", file=sys.stderr)
+        return 2
+
+    # merge multiple processes' dumps into one view: objective tables
+    # union (worst budget wins per name), alerts and samples summed
+    merged: Optional[dict] = None
+    for d in dumps:
+        if merged is None:
+            merged = dict(d)
+            merged["objectives"] = list(d.get("objectives") or [])
+            merged["alerts"] = list(d.get("alerts") or [])
+            merged["samples"] = list(d.get("samples") or [])
+            continue
+        merged["alerts"] += d.get("alerts") or []
+        merged["samples"] += d.get("samples") or []
+        have = {o["name"]: i
+                for i, o in enumerate(merged["objectives"])}
+        for o in d.get("objectives") or []:
+            i = have.get(o["name"])
+            if i is None:
+                merged["objectives"].append(o)
+            else:
+                cur = merged["objectives"][i]
+                cr = (cur.get("budget") or {}).get("remaining")
+                nr = (o.get("budget") or {}).get("remaining")
+                if nr is not None and (cr is None or nr < cr):
+                    merged["objectives"][i] = o
+    report = build_report(merged)
+
+    if args.json:
+        print(json.dumps(report))
+        return 0
+
+    print(f"slo: {report['comm_steps']} comm steps observed, "
+          f"{len(report['objectives'])} objective(s), "
+          f"{report['alerts']} alert(s), worst burn "
+          f"{report.get('worst_burn')}")
+    print("error budget (worst first):")
+    for o in report["objectives"]:
+        b = o.get("budget") or {}
+        print(f"  {o['name']:<20} spent {b.get('spent')}"
+              f"/{b.get('total')}  remaining {b.get('remaining')}  "
+              f"compliance {b.get('compliance')}  "
+              f"burn fast/slow {o.get('burn_fast')}"
+              f"/{o.get('burn_slow')}")
+    if report["exhausted"]:
+        print(f"EXHAUSTED budgets: {report['exhausted']} — /healthz "
+              "is critical while this set is non-empty")
+    for kind in ALERT_PRIORITY:
+        n = report["alerts_by_kind"].get(kind)
+        if n:
+            print(f"  alert {kind:<22} x{n}")
+    canary = report.get("canary")
+    if canary:
+        last = canary.get("last") or {}
+        verdict = ("PASS" if last.get("ok")
+                   else "FAIL" if last else "n/a")
+        print(f"canary: {verdict} (probes "
+              f"{canary.get('probes', '?')}, wire "
+              f"{last.get('wire', '?')}, max dev "
+              f"{last.get('max_dev', '?')})")
+        for e in (last.get("edges") or [])[:4]:
+            print(f"  failing edge {e[0]}->{e[1]} round {e[2]} "
+                  f"dev {e[3]}")
+    tl = report["burn_timeline"]
+    if tl:
+        recent = tl[-8:]
+        line = ", ".join(
+            f"{p['step']}:{p['worst_burn']:g}" for p in recent
+        )
+        print(f"burn timeline (step:burn, last {len(recent)}): "
+              f"{line}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)
